@@ -1,0 +1,42 @@
+"""ballista_tpu — a TPU-native distributed SQL query engine.
+
+A ground-up rebuild of the capabilities of Apache Arrow Ballista
+(reference: /root/reference, a Rust engine built on DataFusion/Arrow/Flight)
+designed TPU-first:
+
+- Columnar data lives on device as padded, statically-shaped JAX arrays
+  (``ballista_tpu.columnar``); strings are dictionary-encoded host-side.
+- All operator kernels (filter, projection, hash aggregate, hash join, sort,
+  hash partition) are XLA programs (``ballista_tpu.ops``) — no numpy stand-ins
+  on the compute path.
+- The engine substrate the reference outsources to DataFusion (SQL parser →
+  logical plan → optimizer → physical plan) is built here
+  (``ballista_tpu.sql``, ``ballista_tpu.plan``, ``ballista_tpu.exec``).
+- Distribution follows the reference's architecture (scheduler splits physical
+  plans into query stages at repartition boundaries; executors run stage
+  partitions as tasks) with two shuffle tiers: on-pod exchange via
+  ``jax.lax.all_to_all`` over ICI inside jitted stage programs
+  (``ballista_tpu.parallel``), and cross-pod / CPU-compat exchange via Arrow
+  IPC files served over Arrow Flight (``ballista_tpu.executor``).
+
+Layer map mirrors the reference (see SURVEY.md §1):
+  client   -> ballista_tpu.client   (BallistaContext: ref ballista/rust/client/src/context.rs:76-308)
+  scheduler-> ballista_tpu.scheduler(ref ballista/rust/scheduler/src)
+  executor -> ballista_tpu.executor (ref ballista/rust/executor/src)
+  core     -> ballista_tpu.{plan,exec,serde,config,errors}
+  engine   -> ballista_tpu.{sql,ops,columnar}  (the DataFusion-equivalent substrate)
+"""
+
+import jax as _jax
+
+# A SQL engine needs real 64-bit columns: int64 keys (TPC-H orderkey exceeds
+# 2^31 at SF100) and float64 money sums. JAX's default silently downcasts to
+# 32-bit, which corrupts both — enable x64 before any array is created.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.errors import BallistaError
+
+__all__ = ["BallistaConfig", "BallistaError", "__version__"]

@@ -1,0 +1,14 @@
+from ballista_tpu.columnar.batch import DeviceBatch, round_capacity
+from ballista_tpu.columnar.arrow_interop import (
+    batch_from_arrow,
+    batch_to_arrow,
+    table_from_arrow,
+)
+
+__all__ = [
+    "DeviceBatch",
+    "round_capacity",
+    "batch_from_arrow",
+    "batch_to_arrow",
+    "table_from_arrow",
+]

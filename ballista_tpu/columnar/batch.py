@@ -1,0 +1,199 @@
+"""DeviceBatch — the columnar batch living on TPU.
+
+The reference's unit of data is an Arrow ``RecordBatch`` flowing through
+DataFusion operators. On TPU, XLA wants static shapes, so a DeviceBatch is:
+
+- one device array per column, all padded to a shared static ``capacity``
+  (rounded up to a bucket size so kernels recompile only per bucket, not per
+  row count — SURVEY.md §7 "Dynamic shapes on XLA");
+- a ``valid`` boolean row mask: padding rows and filtered-out rows are simply
+  invalid. Filters never move data; compaction is an explicit op
+  (:mod:`ballista_tpu.ops.compact`) used before shuffles and joins.
+- optional per-column null masks (True = null) for nullable data;
+- host-side dictionaries for STRING columns (device sees int32 codes).
+
+This replaces the reference's RecordBatch+Arrow-array stack
+(used throughout e.g. ballista/rust/core/src/execution_plans/shuffle_writer.rs:209-256)
+with a representation XLA can tile onto the MXU/VPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ballista_tpu.datatypes import DataType, Field, Schema
+from ballista_tpu.errors import InternalError, SchemaError
+
+# Minimum batch capacity. 2048 = 8 sublanes * 256 — comfortably tileable; we
+# round capacities to powers of two above this so the jit cache stays small.
+MIN_CAPACITY = 2048
+
+
+def round_capacity(n: int) -> int:
+    """Round a row count up to the bucketed static capacity."""
+    if n <= MIN_CAPACITY:
+        return MIN_CAPACITY
+    return 1 << (n - 1).bit_length()
+
+
+@dataclasses.dataclass(frozen=True)
+class Dictionary:
+    """Host-side dictionary for a STRING column: code i <-> values[i]."""
+
+    values: tuple[str, ...]
+
+    def index_of(self, s: str) -> int:
+        try:
+            return self.values.index(s)
+        except ValueError:
+            return -1
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DeviceBatch:
+    """A statically-shaped columnar batch. Columns/valid/nulls are jnp arrays
+    (pytree leaves); schema and dictionaries are static aux data."""
+
+    schema: Schema
+    columns: tuple[jnp.ndarray, ...]
+    valid: jnp.ndarray  # bool[capacity]
+    nulls: tuple[jnp.ndarray | None, ...]  # per-column True=null, or None
+    dictionaries: Mapping[str, Dictionary]  # for STRING columns
+
+    # -- pytree protocol (lets DeviceBatch flow through jit/shard_map) -------
+    def tree_flatten(self):
+        leaves = (self.columns, self.valid, self.nulls)
+        aux = (self.schema, tuple(sorted(self.dictionaries.items())))
+        return leaves, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        columns, valid, nulls = leaves
+        schema, dict_items = aux
+        return cls(schema, tuple(columns), valid, tuple(nulls), dict(dict_items))
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def from_host(
+        cls,
+        schema: Schema,
+        arrays: Sequence[np.ndarray],
+        num_rows: int | None = None,
+        dictionaries: Mapping[str, Dictionary] | None = None,
+        nulls: Sequence[np.ndarray | None] | None = None,
+        capacity: int | None = None,
+    ) -> "DeviceBatch":
+        """Pad host arrays to a bucketed capacity and move them to device."""
+        if len(arrays) != len(schema):
+            raise SchemaError(
+                f"{len(arrays)} arrays for {len(schema)} fields"
+            )
+        n = num_rows if num_rows is not None else (len(arrays[0]) if arrays else 0)
+        cap = capacity if capacity is not None else round_capacity(n)
+        if cap < n:
+            raise InternalError(f"capacity {cap} < num_rows {n}")
+        cols = []
+        for field, arr in zip(schema, arrays):
+            want = field.dtype.to_np()
+            a = np.asarray(arr)
+            if a.dtype != want:
+                a = a.astype(want)
+            padded = np.zeros(cap, dtype=want)
+            padded[:n] = a[:n]
+            cols.append(jnp.asarray(padded))
+        valid = np.zeros(cap, dtype=bool)
+        valid[:n] = True
+        null_cols: list[jnp.ndarray | None] = []
+        for i in range(len(schema)):
+            nm = None if nulls is None else nulls[i]
+            if nm is None:
+                null_cols.append(None)
+            else:
+                pm = np.zeros(cap, dtype=bool)
+                pm[:n] = np.asarray(nm, dtype=bool)[:n]
+                null_cols.append(jnp.asarray(pm))
+        return cls(
+            schema=schema,
+            columns=tuple(cols),
+            valid=jnp.asarray(valid),
+            nulls=tuple(null_cols),
+            dictionaries=dict(dictionaries or {}),
+        )
+
+    @classmethod
+    def empty(cls, schema: Schema, capacity: int = MIN_CAPACITY) -> "DeviceBatch":
+        return cls.from_host(
+            schema, [np.zeros(0, f.dtype.to_np()) for f in schema], 0, capacity=capacity
+        )
+
+    # -- accessors -----------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return int(self.valid.shape[0])
+
+    def column(self, name: str) -> jnp.ndarray:
+        return self.columns[self.schema.index_of(name)]
+
+    def null_mask(self, name: str) -> jnp.ndarray | None:
+        return self.nulls[self.schema.index_of(name)]
+
+    def count_valid(self) -> jnp.ndarray:
+        """Number of live rows, as a device scalar."""
+        return jnp.sum(self.valid.astype(jnp.int32))
+
+    def num_rows(self) -> int:
+        """Number of live rows, blocking on device (host-side use only)."""
+        return int(self.count_valid())
+
+    def with_columns(
+        self,
+        schema: Schema,
+        columns: Sequence[jnp.ndarray],
+        nulls: Sequence[jnp.ndarray | None] | None = None,
+        dictionaries: Mapping[str, Dictionary] | None = None,
+    ) -> "DeviceBatch":
+        """Same rows/validity, different column set (projection output)."""
+        return DeviceBatch(
+            schema=schema,
+            columns=tuple(columns),
+            valid=self.valid,
+            nulls=tuple(nulls) if nulls is not None else tuple([None] * len(schema)),
+            dictionaries=dict(
+                dictionaries if dictionaries is not None else self.dictionaries
+            ),
+        )
+
+    def with_valid(self, valid: jnp.ndarray) -> "DeviceBatch":
+        return DeviceBatch(
+            schema=self.schema,
+            columns=self.columns,
+            valid=valid,
+            nulls=self.nulls,
+            dictionaries=dict(self.dictionaries),
+        )
+
+    # -- host materialization ------------------------------------------------
+    def to_host(self) -> tuple[Schema, list[np.ndarray], list[np.ndarray | None]]:
+        """Gather live rows back to host (compacts: drops invalid rows).
+
+        Returns (schema, columns, null_masks) with exact row count.
+        """
+        valid = np.asarray(self.valid)
+        idx = np.nonzero(valid)[0]
+        cols = [np.asarray(c)[idx] for c in self.columns]
+        nulls = [None if m is None else np.asarray(m)[idx] for m in self.nulls]
+        return self.schema, cols, nulls
+
+    def __repr__(self) -> str:
+        return (
+            f"DeviceBatch({self.schema!r}, capacity={self.capacity})"
+        )

@@ -1,0 +1,231 @@
+"""Validated, typed, string-keyed session configuration.
+
+Mirrors the reference's ``BallistaConfig`` (reference:
+ballista/rust/core/src/config.rs:30-281): a map of string settings with
+per-key validation and typed getters, plus the task scheduling policy enum
+(config.rs:264). These settings travel with every query (serialized as
+key-value pairs in ExecuteQuery — ref proto ballista.proto:844-853) and are
+rebuilt into the executor's task context.
+
+TPU-specific keys added beyond the reference: target batch capacity rounding
+(XLA static shapes), device placement policy, and aggregate/join table
+capacities (XLA needs static output bounds).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import Enum
+from typing import Callable
+
+from ballista_tpu.errors import ConfigError
+
+# Reference key names kept verbatim where they exist (config.rs:30-40) so that
+# configs written for the reference work unchanged.
+BALLISTA_JOB_NAME = "ballista.job.name"
+BALLISTA_DEFAULT_SHUFFLE_PARTITIONS = "ballista.shuffle.partitions"
+BALLISTA_DEFAULT_BATCH_SIZE = "ballista.batch.size"
+BALLISTA_REPARTITION_JOINS = "ballista.repartition.joins"
+BALLISTA_REPARTITION_AGGREGATIONS = "ballista.repartition.aggregations"
+BALLISTA_REPARTITION_WINDOWS = "ballista.repartition.windows"
+BALLISTA_PARQUET_PRUNING = "ballista.parquet.pruning"
+BALLISTA_WITH_INFORMATION_SCHEMA = "ballista.with_information_schema"
+BALLISTA_PLUGIN_DIR = "ballista.plugin_dir"
+
+# TPU-native extensions.
+BALLISTA_DEVICE = "ballista.tpu.device"  # "tpu" | "cpu" | "auto"
+BALLISTA_AGG_CAPACITY = "ballista.tpu.agg_capacity"  # max distinct groups per kernel
+BALLISTA_JOIN_EXPANSION = "ballista.tpu.join_expansion"  # probe-output expansion factor
+BALLISTA_COLLECTIVE_SHUFFLE = "ballista.tpu.collective_shuffle"  # on-pod all_to_all
+
+
+class TaskSchedulingPolicy(Enum):
+    """Pull vs push task dispatch (ref config.rs:264-281)."""
+
+    PULL_STAGED = "pull-staged"
+    PUSH_STAGED = "push-staged"
+
+    @classmethod
+    def parse(cls, s: str) -> "TaskSchedulingPolicy":
+        for p in cls:
+            if p.value == s.lower():
+                return p
+        raise ConfigError(f"invalid task scheduling policy: {s!r}")
+
+
+def _parse_bool(s: str) -> bool:
+    if s.lower() in ("true", "1", "yes"):
+        return True
+    if s.lower() in ("false", "0", "no"):
+        return False
+    raise ValueError(f"not a boolean: {s!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigEntry:
+    """One valid setting: name, description, validator (ref config.rs:60-92)."""
+
+    name: str
+    description: str
+    default: str
+    parse: Callable[[str], object]
+
+
+def _entries() -> dict[str, ConfigEntry]:
+    """The closed set of valid settings (ref config.rs valid_entries :156-187)."""
+    ents = [
+        ConfigEntry(BALLISTA_JOB_NAME, "Job name shown in the UI", "", str),
+        ConfigEntry(
+            BALLISTA_DEFAULT_SHUFFLE_PARTITIONS,
+            "Shuffle (exchange) output partition count",
+            "2",
+            int,
+        ),
+        ConfigEntry(
+            BALLISTA_DEFAULT_BATCH_SIZE, "Rows per record batch", "8192", int
+        ),
+        ConfigEntry(
+            BALLISTA_REPARTITION_JOINS,
+            "Repartition inputs of joins for parallelism",
+            "true",
+            _parse_bool,
+        ),
+        ConfigEntry(
+            BALLISTA_REPARTITION_AGGREGATIONS,
+            "Repartition inputs of aggregations for parallelism",
+            "true",
+            _parse_bool,
+        ),
+        ConfigEntry(
+            BALLISTA_REPARTITION_WINDOWS,
+            "Repartition inputs of window functions",
+            "true",
+            _parse_bool,
+        ),
+        ConfigEntry(
+            BALLISTA_PARQUET_PRUNING,
+            "Prune parquet row groups by statistics",
+            "true",
+            _parse_bool,
+        ),
+        ConfigEntry(
+            BALLISTA_WITH_INFORMATION_SCHEMA,
+            "Expose information_schema tables (needed for SHOW)",
+            "false",
+            _parse_bool,
+        ),
+        ConfigEntry(BALLISTA_PLUGIN_DIR, "UDF plugin directory", "", str),
+        ConfigEntry(BALLISTA_DEVICE, "Execution device: tpu|cpu|auto", "auto", str),
+        ConfigEntry(
+            BALLISTA_AGG_CAPACITY,
+            "Static capacity (max distinct groups) of device hash aggregates",
+            str(1 << 16),
+            int,
+        ),
+        ConfigEntry(
+            BALLISTA_JOIN_EXPANSION,
+            "Max probe-output rows per input row for non-unique joins",
+            "4",
+            int,
+        ),
+        ConfigEntry(
+            BALLISTA_COLLECTIVE_SHUFFLE,
+            "Use jax.lax.all_to_all over ICI for on-pod shuffles",
+            "true",
+            _parse_bool,
+        ),
+    ]
+    return {e.name: e for e in ents}
+
+
+_VALID = _entries()
+
+
+class BallistaConfig:
+    """Validated session config (ref config.rs:94-259).
+
+    Construct via :meth:`builder` / :meth:`with_setting` or ``from_settings``.
+    Unknown keys and unparsable values raise :class:`ConfigError` — the same
+    contract the reference enforces in ``BallistaConfigBuilder::build``.
+    """
+
+    def __init__(self, settings: dict[str, str] | None = None):
+        self._settings: dict[str, str] = {}
+        for k, v in (settings or {}).items():
+            self._validate(k, v)
+            self._settings[k] = v
+
+    @staticmethod
+    def _validate(key: str, value: str) -> None:
+        entry = _VALID.get(key)
+        if entry is None:
+            raise ConfigError(f"unknown configuration key: {key!r}")
+        try:
+            entry.parse(value)
+        except Exception as e:
+            raise ConfigError(
+                f"invalid value {value!r} for {key!r}: {e}"
+            ) from e
+
+    @classmethod
+    def builder(cls) -> "BallistaConfig":
+        return cls()
+
+    def with_setting(self, key: str, value: str) -> "BallistaConfig":
+        new = dict(self._settings)
+        self._validate(key, value)
+        new[key] = value
+        return BallistaConfig(new)
+
+    def settings(self) -> dict[str, str]:
+        return dict(self._settings)
+
+    def _get(self, key: str):
+        entry = _VALID[key]
+        raw = self._settings.get(key, entry.default)
+        return entry.parse(raw)
+
+    # Typed getters (ref config.rs:193-258).
+    def default_shuffle_partitions(self) -> int:
+        return self._get(BALLISTA_DEFAULT_SHUFFLE_PARTITIONS)
+
+    def default_batch_size(self) -> int:
+        return self._get(BALLISTA_DEFAULT_BATCH_SIZE)
+
+    def repartition_joins(self) -> bool:
+        return self._get(BALLISTA_REPARTITION_JOINS)
+
+    def repartition_aggregations(self) -> bool:
+        return self._get(BALLISTA_REPARTITION_AGGREGATIONS)
+
+    def repartition_windows(self) -> bool:
+        return self._get(BALLISTA_REPARTITION_WINDOWS)
+
+    def parquet_pruning(self) -> bool:
+        return self._get(BALLISTA_PARQUET_PRUNING)
+
+    def with_information_schema(self) -> bool:
+        return self._get(BALLISTA_WITH_INFORMATION_SCHEMA)
+
+    def plugin_dir(self) -> str:
+        return self._get(BALLISTA_PLUGIN_DIR)
+
+    def device(self) -> str:
+        return self._get(BALLISTA_DEVICE)
+
+    def agg_capacity(self) -> int:
+        return self._get(BALLISTA_AGG_CAPACITY)
+
+    def join_expansion(self) -> int:
+        return self._get(BALLISTA_JOIN_EXPANSION)
+
+    def collective_shuffle(self) -> bool:
+        return self._get(BALLISTA_COLLECTIVE_SHUFFLE)
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, BallistaConfig) and other._settings == self._settings
+        )
+
+    def __repr__(self) -> str:
+        return f"BallistaConfig({self._settings!r})"

@@ -1,0 +1,47 @@
+"""Error model.
+
+Mirrors the reference's ``BallistaError`` enum (reference:
+ballista/rust/core/src/error.rs:33-185) as a Python exception hierarchy.
+"""
+
+from __future__ import annotations
+
+
+class BallistaError(Exception):
+    """Base error for the framework (ref error.rs:33)."""
+
+
+class NotImplementedError_(BallistaError):
+    """Feature not implemented (ref error.rs NotImplemented variant)."""
+
+
+class InternalError(BallistaError):
+    """Invariant violation — a bug in the engine (ref error.rs Internal)."""
+
+
+class PlanError(BallistaError):
+    """Logical/physical planning failure (ref error.rs DataFusionError)."""
+
+
+class SqlError(BallistaError):
+    """SQL parse/analysis failure (ref error.rs SqlError)."""
+
+
+class SchemaError(BallistaError):
+    """Schema mismatch or unknown column."""
+
+
+class IoError(BallistaError):
+    """Filesystem / IPC failure (ref error.rs IoError)."""
+
+
+class GrpcError(BallistaError):
+    """Control-plane RPC failure (ref error.rs TonicError/GrpcError)."""
+
+
+class ConfigError(BallistaError):
+    """Invalid configuration (ref config.rs validation errors)."""
+
+
+class ExecutionError(BallistaError):
+    """Runtime failure while executing a physical plan."""

@@ -1,0 +1,39 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding and collective paths are
+validated on a virtual CPU mesh exactly as the driver's dryrun does
+(xla_force_host_platform_device_count). Must run before jax import.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+import pyarrow as pa  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def sample_table() -> pa.Table:
+    """A small mixed-type Arrow table used across substrate/ops tests."""
+    n = 1000
+    r = np.random.default_rng(7)
+    return pa.table(
+        {
+            "id": pa.array(np.arange(n, dtype=np.int64)),
+            "grp": pa.array(r.integers(0, 5, n).astype(np.int32)),
+            "price": pa.array(r.uniform(0, 100, n)),
+            "qty": pa.array(r.integers(1, 50, n).astype(np.int64)),
+            "flag": pa.array([["A", "B", "C"][i % 3] for i in range(n)]),
+            "ship": pa.array(
+                (np.arange(n) % 2000 + 8000).astype("int32"), type=pa.int32()
+            ).cast(pa.date32()),
+        }
+    )
