@@ -90,10 +90,18 @@ def _column_to_np(
         )
 
     if dtype == DataType.STRING:
-        if not pa.types.is_dictionary(col.type):
-            col = col.dictionary_encode()
-        values = tuple(col.dictionary.to_pylist())
-        codes = np.asarray(col.indices.fill_null(0)).astype(np.int32)
+        import pyarrow.compute as pc
+
+        # Order-preserving dictionary: values sorted lexicographically, so
+        # int32 codes compare/sort/min/max exactly like the strings do on
+        # device (ORDER BY and range predicates need no host round-trip).
+        if pa.types.is_dictionary(col.type):
+            col = col.cast(col.type.value_type)
+        uniq = pc.unique(col).drop_null()
+        order = pc.array_sort_indices(uniq)
+        values = tuple(uniq.take(order).to_pylist())
+        codes_arr = pc.index_in(col, pa.array(values, type=col.type))
+        codes = np.asarray(codes_arr.fill_null(0)).astype(np.int32)
         return codes, null_mask, Dictionary(values)
 
     if pa.types.is_decimal(col.type) or pa.types.is_floating(col.type):

@@ -1,0 +1,29 @@
+"""Device kernels: every relational operator's compute is an XLA program.
+
+The reference gets these operators from DataFusion (hash aggregate, hash
+join, sort, filter — external crate); here they are JAX kernels designed for
+the TPU's strengths: large batched vector ops, ``lax.sort``-based grouping
+and joining (no data-dependent control flow), segment reductions, and static
+output capacities everywhere (SURVEY.md §7 "Hard parts").
+"""
+
+from ballista_tpu.ops.hashing import hash_columns
+from ballista_tpu.ops.compact import compact
+from ballista_tpu.ops.sort import sort_batch, SortKey
+from ballista_tpu.ops.aggregate import AggOp, group_aggregate, scalar_aggregate
+from ballista_tpu.ops.join import JoinSide, build_side, probe_side
+from ballista_tpu.ops.partition import partition_ids
+
+__all__ = [
+    "hash_columns",
+    "compact",
+    "sort_batch",
+    "SortKey",
+    "AggOp",
+    "group_aggregate",
+    "scalar_aggregate",
+    "JoinSide",
+    "build_side",
+    "probe_side",
+    "partition_ids",
+]

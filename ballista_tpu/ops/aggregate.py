@@ -1,0 +1,206 @@
+"""Grouped and scalar aggregation kernels.
+
+Replaces DataFusion's HashAggregateExec (the reference serializes it at
+ballista/rust/core/src/serde/physical_plan/mod.rs HashAggregateExecNode arm;
+proto ballista.proto:275-623). TPU-native design: **sort-based grouping** —
+one fused ``lax.sort`` on the key columns, segment-boundary detection, then
+``segment_sum/min/max`` reductions. No hash table, no data-dependent control
+flow, fully static shapes with a configurable group-capacity bound
+(``ballista.tpu.agg_capacity``); overflow is detected on device and raised
+host-side.
+
+Two-phase distributed aggregation mirrors the reference's partial/final
+split: partials produced per batch/partition are merged by re-running
+group_aggregate with the merge ops (COUNT merges via SUM, etc.).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import Enum
+
+import jax
+import jax.numpy as jnp
+
+from ballista_tpu.errors import ExecutionError
+
+
+class AggOp(Enum):
+    SUM = "sum"
+    COUNT = "count"  # COUNT(expr): counts non-null; COUNT(*) passes no nulls
+    MIN = "min"
+    MAX = "max"
+
+    @property
+    def merge_op(self) -> "AggOp":
+        """Op used to merge partial states (COUNT partials merge by SUM)."""
+        return AggOp.SUM if self == AggOp.COUNT else self
+
+
+def _max_ident(dtype) -> jnp.ndarray:
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf, dtype=dtype)
+    return jnp.array(jnp.iinfo(dtype).max, dtype=dtype)
+
+
+def _min_ident(dtype) -> jnp.ndarray:
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(-jnp.inf, dtype=dtype)
+    return jnp.array(jnp.iinfo(dtype).min, dtype=dtype)
+
+
+@dataclasses.dataclass
+class GroupAggResult:
+    """Device-side aggregation output, all arrays of length ``capacity``."""
+
+    keys: list[jnp.ndarray]
+    key_nulls: list[jnp.ndarray | None]
+    values: list[jnp.ndarray]
+    value_nulls: list[jnp.ndarray | None]
+    valid: jnp.ndarray  # bool[capacity] — which output slots are groups
+    n_groups: jnp.ndarray  # int32 scalar
+    overflow: jnp.ndarray  # bool scalar: more groups than capacity
+
+    def check_overflow(self) -> None:
+        if bool(self.overflow):
+            raise ExecutionError(
+                f"aggregate exceeded group capacity "
+                f"({int(self.n_groups)} groups); raise ballista.tpu.agg_capacity"
+            )
+
+
+def group_aggregate(
+    key_cols: list[jnp.ndarray],
+    key_nulls: list[jnp.ndarray | None],
+    valid: jnp.ndarray,
+    val_cols: list[jnp.ndarray],
+    val_nulls: list[jnp.ndarray | None],
+    ops: list[AggOp],
+    capacity: int,
+) -> GroupAggResult:
+    """Aggregate ``val_cols[i]`` with ``ops[i]`` grouped by ``key_cols``.
+
+    All inputs share one row axis; ``valid`` masks live rows. Outputs have
+    static length ``capacity`` with a validity mask over actual groups.
+    """
+    n = valid.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+
+    # SQL GROUP BY: NULL is its own group. Null keys get a flag operand and a
+    # zeroed value so all-null rows compare equal.
+    operands: list[jnp.ndarray] = [~valid]  # valid rows first
+    for kc, kn in zip(key_cols, key_nulls):
+        if kn is not None:
+            operands.append(kn)
+            operands.append(jnp.where(kn, jnp.zeros_like(kc), kc))
+        else:
+            operands.append(kc)
+    num_keys = len(operands)
+    sorted_ops = jax.lax.sort(
+        operands + [iota], num_keys=num_keys, is_stable=True
+    )
+    perm = sorted_ops[-1]
+    s_valid = valid[perm]
+
+    # Segment boundaries: first row, or any key operand differs from previous.
+    changed = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for op_arr in sorted_ops[1:num_keys]:
+        changed = changed | jnp.concatenate(
+            [jnp.ones(1, dtype=bool), op_arr[1:] != op_arr[:-1]]
+        )
+    seg_id = jnp.cumsum(changed.astype(jnp.int32)) - 1
+    n_groups = jnp.max(jnp.where(s_valid, seg_id, -1)) + 1
+    overflow = n_groups > capacity
+
+    # Scatter original key values (one write per row; all rows of a segment
+    # carry equal keys). Invalid rows scatter to index `capacity` -> dropped.
+    scatter_id = jnp.where(s_valid, seg_id, capacity)
+    out_keys, out_key_nulls = [], []
+    for kc, kn in zip(key_cols, key_nulls):
+        s_kc = kc[perm]
+        out_keys.append(
+            jnp.zeros(capacity, dtype=kc.dtype).at[scatter_id].set(
+                s_kc, mode="drop"
+            )
+        )
+        if kn is None:
+            out_key_nulls.append(None)
+        else:
+            s_kn = kn[perm]
+            out_key_nulls.append(
+                jnp.zeros(capacity, dtype=bool).at[scatter_id].set(
+                    s_kn, mode="drop"
+                )
+            )
+
+    out_vals, out_val_nulls = [], []
+    for vc, vn, op in zip(val_cols, val_nulls, ops):
+        s_vc = vc[perm]
+        live = s_valid if vn is None else (s_valid & ~vn[perm])
+        # segment index for reductions: dead rows dropped via `capacity`.
+        rid = jnp.where(live, seg_id, capacity)
+        nonnull_cnt = (
+            jnp.zeros(capacity, dtype=jnp.int64).at[rid].add(1, mode="drop")
+        )
+        if op == AggOp.COUNT:
+            out_vals.append(nonnull_cnt)
+            out_val_nulls.append(None)
+            continue
+        if op == AggOp.SUM:
+            contrib = jnp.where(live, s_vc, jnp.zeros_like(s_vc))
+            out = jnp.zeros(capacity, dtype=vc.dtype).at[rid].add(
+                contrib, mode="drop"
+            )
+        elif op == AggOp.MIN:
+            masked = jnp.where(live, s_vc, _max_ident(vc.dtype))
+            out = jnp.full(capacity, _max_ident(vc.dtype)).at[rid].min(
+                masked, mode="drop"
+            )
+        elif op == AggOp.MAX:
+            masked = jnp.where(live, s_vc, _min_ident(vc.dtype))
+            out = jnp.full(capacity, _min_ident(vc.dtype)).at[rid].max(
+                masked, mode="drop"
+            )
+        else:  # pragma: no cover
+            raise ExecutionError(f"unknown agg op {op}")
+        out_vals.append(out)
+        out_val_nulls.append(nonnull_cnt == 0)  # SQL: agg over no values is NULL
+
+    out_valid = jnp.arange(capacity, dtype=jnp.int32) < n_groups
+    return GroupAggResult(
+        keys=out_keys,
+        key_nulls=out_key_nulls,
+        values=out_vals,
+        value_nulls=out_val_nulls,
+        valid=out_valid,
+        n_groups=n_groups.astype(jnp.int32),
+        overflow=overflow,
+    )
+
+
+def scalar_aggregate(
+    valid: jnp.ndarray,
+    val_cols: list[jnp.ndarray],
+    val_nulls: list[jnp.ndarray | None],
+    ops: list[AggOp],
+) -> tuple[list[jnp.ndarray], list[jnp.ndarray | None]]:
+    """Ungrouped aggregation -> one scalar per op (+ null flags)."""
+    outs: list[jnp.ndarray] = []
+    nulls: list[jnp.ndarray | None] = []
+    for vc, vn, op in zip(val_cols, val_nulls, ops):
+        live = valid if vn is None else (valid & ~vn)
+        cnt = jnp.sum(live.astype(jnp.int64))
+        if op == AggOp.COUNT:
+            outs.append(cnt)
+            nulls.append(None)
+            continue
+        if op == AggOp.SUM:
+            outs.append(jnp.sum(jnp.where(live, vc, jnp.zeros_like(vc))))
+        elif op == AggOp.MIN:
+            outs.append(jnp.min(jnp.where(live, vc, _max_ident(vc.dtype))))
+        elif op == AggOp.MAX:
+            outs.append(jnp.max(jnp.where(live, vc, _min_ident(vc.dtype))))
+        else:  # pragma: no cover
+            raise ExecutionError(f"unknown agg op {op}")
+        nulls.append(cnt == 0)
+    return outs, nulls

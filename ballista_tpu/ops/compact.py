@@ -1,0 +1,28 @@
+"""Compaction: move live rows to the front of a batch.
+
+Filters in this engine only clear validity bits (no data movement). Before
+ops that are sensitive to row placement — shuffle writes, join builds,
+limits — an explicit compaction gathers live rows to the front via a stable
+argsort of the invalid flag (static-shaped; XLA-friendly; no host sync).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ballista_tpu.columnar.batch import DeviceBatch
+
+
+def compact(batch: DeviceBatch) -> DeviceBatch:
+    order = jnp.argsort(~batch.valid, stable=True)
+    n = jnp.sum(batch.valid.astype(jnp.int32))
+    cols = tuple(c[order] for c in batch.columns)
+    nulls = tuple(None if m is None else m[order] for m in batch.nulls)
+    valid = jnp.arange(batch.capacity, dtype=jnp.int32) < n
+    return DeviceBatch(
+        schema=batch.schema,
+        columns=cols,
+        valid=valid,
+        nulls=nulls,
+        dictionaries=dict(batch.dictionaries),
+    )

@@ -1,0 +1,30 @@
+"""Hash partitioning for shuffle exchanges.
+
+The reference's ShuffleWriterExec hash-partitions every RecordBatch into N
+output buckets (ref ballista/rust/core/src/execution_plans/
+shuffle_writer.rs:201-285). Here the per-row partition id is computed on
+device; the two shuffle tiers consume it differently:
+
+- cross-pod / file tier: ids come back to host, rows are split with numpy
+  takes and written as Arrow IPC (executor.shuffle);
+- on-pod ICI tier: rows are binned to equal-capacity buckets on device and
+  exchanged with ``jax.lax.all_to_all`` (parallel.collective).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.ops.hashing import hash_columns
+
+
+def partition_ids(
+    batch: DeviceBatch, key_idxs: list[int], num_partitions: int
+) -> jnp.ndarray:
+    """Per-row partition id in [0, num_partitions); invalid rows get
+    num_partitions (a drop bucket)."""
+    cols = [batch.columns[i] for i in key_idxs]
+    h = hash_columns(cols)
+    pid = (h % jnp.uint64(num_partitions)).astype(jnp.int32)
+    return jnp.where(batch.valid, pid, num_partitions)

@@ -1,0 +1,65 @@
+"""Multi-key sort on device.
+
+Replaces DataFusion's SortExec (referenced by the plan serde at
+ballista/rust/core/src/serde/physical_plan/mod.rs sort arm). Uses
+``jax.lax.sort`` with multiple key operands — a single fused, static-shape
+lexicographic sort; all other columns ride along as payload via a permutation
+index. Invalid rows always sort last (leading ``~valid`` key), so a sorted
+batch is also compact.
+
+String columns sort correctly by dictionary code because dictionaries are
+order-preserving (see columnar.arrow_interop).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ballista_tpu.columnar.batch import DeviceBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class SortKey:
+    """One ORDER BY term: column index, direction, null placement."""
+
+    col: int
+    ascending: bool = True
+    nulls_first: bool = False
+
+
+def _direction(col: jnp.ndarray, ascending: bool) -> jnp.ndarray:
+    if ascending:
+        return col
+    if jnp.issubdtype(col.dtype, jnp.integer):
+        return ~col  # ~x = -x-1: total order reversal incl. INT_MIN
+    if col.dtype == jnp.bool_:
+        return ~col
+    return -col
+
+
+def sort_batch(batch: DeviceBatch, keys: list[SortKey]) -> DeviceBatch:
+    cap = batch.capacity
+    operands: list[jnp.ndarray] = [~batch.valid]  # invalid rows last
+    for k in keys:
+        col = batch.columns[k.col]
+        nm = batch.nulls[k.col]
+        if nm is not None:
+            # Null placement key: 0 sorts before 1.
+            operands.append(nm != k.nulls_first)
+        operands.append(_direction(col, k.ascending))
+    num_keys = len(operands)
+    operands.append(jnp.arange(cap, dtype=jnp.int32))  # payload: permutation
+    sorted_ops = jax.lax.sort(operands, num_keys=num_keys, is_stable=True)
+    perm = sorted_ops[-1]
+    cols = tuple(c[perm] for c in batch.columns)
+    nulls = tuple(None if m is None else m[perm] for m in batch.nulls)
+    return DeviceBatch(
+        schema=batch.schema,
+        columns=cols,
+        valid=batch.valid[perm],
+        nulls=nulls,
+        dictionaries=dict(batch.dictionaries),
+    )

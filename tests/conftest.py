@@ -1,18 +1,35 @@
-"""Test configuration: force an 8-device virtual CPU mesh.
+"""Test configuration.
 
-Multi-chip hardware is not available in CI; sharding and collective paths are
-validated on a virtual CPU mesh exactly as the driver's dryrun does
-(xla_force_host_platform_device_count). Must run before jax import.
+Platform reality in this environment: the axon sitecustomize registers the
+TPU PJRT plugin at interpreter start, so the suite runs on the real TPU chip
+when one is tunnelled (JAX_PLATFORMS set here would be too late). That is
+intentional — kernel tests validating on real TPU semantics caught e.g. the
+missing f64 bitcast in the x64-rewrite pass.
+
+Multi-device (mesh/collective) tests instead launch subprocesses with a
+cleaned environment (see ``cpu_mesh_env``) to get the virtual 8-device CPU
+mesh the driver's dryrun uses.
 """
 
 import os
+import sys
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import pyarrow as pa
+import pytest
 
-import numpy as np  # noqa: E402
-import pyarrow as pa  # noqa: E402
-import pytest  # noqa: E402
+# Environment for subprocesses that need an 8-device virtual CPU mesh.
+CPU_MESH_ENV = {
+    **{k: v for k, v in os.environ.items() if not k.startswith(("PALLAS_AXON", "AXON"))},
+    "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_env():
+    return dict(CPU_MESH_ENV)
 
 
 @pytest.fixture(scope="session")
