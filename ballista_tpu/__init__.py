@@ -27,12 +27,26 @@ Layer map mirrors the reference (see SURVEY.md §1):
   engine   -> ballista_tpu.{sql,ops,columnar}  (the DataFusion-equivalent substrate)
 """
 
+import os as _os
+
 import jax as _jax
 
 # A SQL engine needs real 64-bit columns: int64 keys (TPC-H orderkey exceeds
 # 2^31 at SF100) and float64 money sums. JAX's default silently downcasts to
 # 32-bit, which corrupts both — enable x64 before any array is created.
 _jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: a query plan compiles one XLA program per
+# (operator, batch capacity); over a tunneled TPU each compile costs tens of
+# seconds, so caching across processes is the difference between minutes and
+# milliseconds on re-runs of the same query shapes.
+_cache_dir = _os.environ.get(
+    "BALLISTA_TPU_JAX_CACHE",
+    _os.path.join(_os.path.expanduser("~"), ".cache", "ballista_tpu_jax"),
+)
+if _cache_dir != "off":
+    _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 __version__ = "0.1.0"
 

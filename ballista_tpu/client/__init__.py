@@ -1,0 +1,5 @@
+"""Client layer: the distributed BallistaContext and Flight data client.
+
+ref ballista/rust/client (BallistaContext) and core/src/client.rs
+(BallistaClient Flight wrapper).
+"""

@@ -1,0 +1,165 @@
+"""BallistaContext: the distributed client entry point.
+
+ref ballista/rust/client/src/context.rs:76-439 — remote() creates a
+server-side session via ExecuteQuery-with-no-query (:83-135); standalone()
+boots an in-proc scheduler + executor (:137-207); table registration is
+kept CLIENT-side and travels with each query's serialized logical plan
+(:258-308); sql() intercepts SHOW and CREATE EXTERNAL TABLE (:311-435);
+collect() drives the DistributedQueryExec flow (core/src/execution_plans/
+distributed_query.rs:160-326): submit, poll GetJobStatus every 100ms, then
+Flight-fetch the completed partition locations.
+"""
+
+from __future__ import annotations
+
+import time
+
+import grpc
+import pyarrow as pa
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.errors import BallistaError, GrpcError
+from ballista_tpu.exec.context import DataFrame, TpuContext
+from ballista_tpu.plan.logical import LogicalPlan
+from ballista_tpu.proto import pb
+from ballista_tpu.scheduler.rpc import scheduler_stub
+from ballista_tpu.scheduler_types import PartitionLocation
+from ballista_tpu.serde import logical_to_proto
+from ballista_tpu.sql import ast
+from ballista_tpu.sql.parser import parse_sql
+from ballista_tpu.sql.planner import SqlPlanner
+
+POLL_INTERVAL = 0.1  # ref distributed_query.rs:268
+
+
+class BallistaContext(TpuContext):
+    """Extends the single-process context with a remote scheduler: queries
+    plan logically client-side and execute on the cluster."""
+
+    def __init__(
+        self,
+        scheduler_addr: str,
+        config: BallistaConfig | None = None,
+    ):
+        super().__init__(config)
+        self.scheduler_addr = scheduler_addr
+        self._channel = grpc.insecure_channel(scheduler_addr)
+        self._stub = scheduler_stub(self._channel)
+        # create a server-side session (ref context.rs:83-135)
+        result = self._stub.ExecuteQuery(
+            pb.ExecuteQueryParams(
+                settings=[
+                    pb.KeyValuePair(key=k, value=v)
+                    for k, v in self.config.settings().items()
+                ]
+            )
+        )
+        self.session_id = result.session_id
+        self._standalone_cluster = None
+
+    # -- factory constructors -------------------------------------------------
+    @classmethod
+    def remote(
+        cls, host: str, port: int, config: BallistaConfig | None = None
+    ) -> "BallistaContext":
+        return cls(f"{host}:{port}", config)
+
+    @classmethod
+    def standalone(
+        cls,
+        config: BallistaConfig | None = None,
+        concurrent_tasks: int = 4,
+    ) -> "BallistaContext":
+        """Boot an in-proc scheduler + executor over localhost gRPC/Flight
+        (ref context.rs:137-207 + scheduler/standalone.rs +
+        executor/standalone.rs) — full cluster semantics in one process."""
+        from ballista_tpu.standalone import StandaloneCluster
+
+        cluster = StandaloneCluster.start(config, concurrent_tasks)
+        ctx = cls(f"localhost:{cluster.scheduler_port}", config)
+        ctx._standalone_cluster = cluster
+        # the in-proc scheduler/executor resolve memory tables through the
+        # client's own registry (the reference re-registers per query)
+        cluster.attach_provider(ctx)
+        return ctx
+
+    def close(self) -> None:
+        if self._standalone_cluster is not None:
+            self._standalone_cluster.stop()
+        self._channel.close()
+
+    # -- query execution ------------------------------------------------------
+    def sql(self, sql: str) -> DataFrame:
+        stmt = parse_sql(sql)
+        # DDL/utility statements run client-side (ref context.rs:311-435)
+        if not isinstance(stmt, (ast.Select, ast.SetOp)):
+            return super().sql(sql)
+        logical = SqlPlanner(self).plan(stmt)
+        return RemoteDataFrame(self, logical)
+
+    def collect_logical(self, logical: LogicalPlan) -> pa.Table:
+        """Submit a logical plan, poll to completion, fetch partitions
+        (the DistributedQueryExec flow)."""
+        node = logical_to_proto(logical)
+        result = self._stub.ExecuteQuery(
+            pb.ExecuteQueryParams(
+                logical_plan=node.SerializeToString(),
+                session_id=self.session_id,
+                settings=[
+                    pb.KeyValuePair(key=k, value=v)
+                    for k, v in self.config.settings().items()
+                ],
+            )
+        )
+        job_id = result.job_id
+        deadline = time.time() + 600
+        while True:
+            status = self._stub.GetJobStatus(
+                pb.GetJobStatusParams(job_id=job_id)
+            ).status
+            kind = status.WhichOneof("status")
+            if kind == "completed":
+                return self._fetch_results(status.completed, logical)
+            if kind == "failed":
+                raise BallistaError(
+                    f"job {job_id} failed: {status.failed.error}"
+                )
+            if time.time() > deadline:
+                raise GrpcError(f"job {job_id} timed out")
+            time.sleep(POLL_INTERVAL)
+
+    def _fetch_results(
+        self, completed: pb.CompletedJob, logical: LogicalPlan
+    ) -> pa.Table:
+        from ballista_tpu.executor.reader import fetch_partition_table
+
+        tables = []
+        for loc_p in completed.partition_location:
+            loc = PartitionLocation(
+                job_id=loc_p.partition_id.job_id,
+                stage_id=loc_p.partition_id.stage_id,
+                partition=loc_p.partition_id.partition_id,
+                executor_id=loc_p.executor_meta.id,
+                host=loc_p.executor_meta.host,
+                port=loc_p.executor_meta.port,
+                path=loc_p.path,
+            )
+            t = fetch_partition_table(loc)
+            if t.num_rows:
+                tables.append(t)
+        if not tables:
+            from ballista_tpu.columnar.arrow_interop import schema_to_arrow
+            from ballista_tpu.plan.optimizer import optimize
+
+            schema = schema_to_arrow(optimize(logical).schema())
+            return pa.table(
+                {f.name: pa.array([], type=f.type) for f in schema}
+            )
+        return pa.concat_tables(tables)
+
+
+class RemoteDataFrame(DataFrame):
+    def collect(self) -> pa.Table:
+        if self._const is not None:
+            return self._const
+        return self.ctx.collect_logical(self.logical)

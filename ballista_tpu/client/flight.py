@@ -1,0 +1,41 @@
+"""Arrow Flight data-plane client.
+
+ref ballista/rust/core/src/client.rs:50-178 (BallistaClient): encode a
+protobuf Action{FetchPartition} as the Flight Ticket, `do_get`, read the
+IPC stream. pyarrow.flight is Arrow C++ Flight underneath — the native
+data plane the reference uses, not a Python reimplementation.
+"""
+
+from __future__ import annotations
+
+import pyarrow as pa
+import pyarrow.flight as paflight
+
+from ballista_tpu.errors import GrpcError
+from ballista_tpu.proto import pb
+from ballista_tpu.scheduler_types import PartitionLocation
+
+
+def make_ticket(loc: PartitionLocation) -> paflight.Ticket:
+    action = pb.Action(
+        fetch_partition=pb.FetchPartition(
+            job_id=loc.job_id,
+            stage_id=loc.stage_id,
+            partition_id=loc.partition,
+            path=loc.path,
+        )
+    )
+    return paflight.Ticket(action.SerializeToString())
+
+
+def fetch_partition(loc: PartitionLocation) -> pa.Table:
+    """ref client.rs fetch_partition (:75-130)."""
+    try:
+        client = paflight.connect(f"grpc://{loc.host}:{loc.port}")
+        reader = client.do_get(make_ticket(loc))
+        return reader.read_all()
+    except paflight.FlightError as e:
+        raise GrpcError(
+            f"failed to fetch partition {loc.job_id}/{loc.stage_id}/"
+            f"{loc.partition} from {loc.host}:{loc.port}: {e}"
+        ) from e

@@ -98,9 +98,9 @@ def _column_to_np(
         if pa.types.is_dictionary(col.type):
             col = col.cast(col.type.value_type)
         uniq = pc.unique(col).drop_null()
-        order = pc.array_sort_indices(uniq)
-        values = tuple(uniq.take(order).to_pylist())
-        codes_arr = pc.index_in(col, pa.array(values, type=col.type))
+        sorted_uniq = uniq.take(pc.array_sort_indices(uniq))
+        values = tuple(sorted_uniq.to_pylist())
+        codes_arr = pc.index_in(col, sorted_uniq)
         codes = np.asarray(codes_arr.fill_null(0)).astype(np.int32)
         return codes, null_mask, Dictionary(values)
 

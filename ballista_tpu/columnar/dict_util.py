@@ -1,0 +1,49 @@
+"""Dictionary algebra for string columns.
+
+Device code only ever sees int32 codes; all string semantics live in the
+order-preserving (sorted) host dictionaries. Comparing or joining two string
+columns with *different* dictionaries requires remapping both onto a merged
+dictionary first — the remap is a host-built lookup table gathered on device
+(trace-time constant, so XLA folds it into the program).
+"""
+
+from __future__ import annotations
+
+import bisect
+
+import jax.numpy as jnp
+import numpy as np
+
+from ballista_tpu.columnar.batch import Dictionary
+
+
+def merge_dictionaries(
+    a: Dictionary, b: Dictionary
+) -> tuple[Dictionary, np.ndarray, np.ndarray]:
+    """Merged sorted dictionary + code remap tables for each input.
+
+    ``remap_a[old_code] = new_code`` (and likewise ``remap_b``). Sorted-merge
+    keeps the merged dictionary order-preserving, so remapped codes still
+    compare like the strings they encode.
+    """
+    merged = tuple(sorted(set(a.values) | set(b.values)))
+    pos = {v: i for i, v in enumerate(merged)}
+    remap_a = np.asarray([pos[v] for v in a.values], dtype=np.int32)
+    remap_b = np.asarray([pos[v] for v in b.values], dtype=np.int32)
+    return Dictionary(merged), remap_a, remap_b
+
+
+def remap_codes(codes: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
+    """Gather codes through a host remap table (empty table -> unchanged,
+    the column is all-null)."""
+    if len(table) == 0:
+        return codes
+    return jnp.asarray(table)[jnp.clip(codes, 0, len(table) - 1)]
+
+
+def bisect_left(d: Dictionary, s: str) -> int:
+    return bisect.bisect_left(d.values, s)
+
+
+def bisect_right(d: Dictionary, s: str) -> int:
+    return bisect.bisect_right(d.values, s)

@@ -1,0 +1,90 @@
+"""Generic event loop: a single-writer actor over a queue.
+
+ref ballista/rust/core/src/event_loop.rs:27-141 — ``EventAction<E>`` trait
+{on_start, on_stop, on_receive -> Option<E>}, buffer 10000, self-reposting.
+Thread-based here (the gRPC servicers are thread-driven); the single
+consumer thread gives the same data-race freedom the reference gets from
+the tokio mpsc single-receiver.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+
+log = logging.getLogger(__name__)
+
+_BUFFER = 10000
+
+
+class EventAction:
+    """ref event_loop.rs EventAction trait."""
+
+    def on_start(self) -> None:
+        pass
+
+    def on_stop(self) -> None:
+        pass
+
+    def on_receive(self, event) -> object | None:
+        """Handle one event; optionally return a follow-up event to post."""
+        raise NotImplementedError
+
+    def on_error(self, error: BaseException) -> None:
+        log.error("event loop error: %s", error, exc_info=error)
+
+
+class EventLoop:
+    def __init__(self, name: str, action: EventAction):
+        self.name = name
+        self.action = action
+        self._q: queue.Queue = queue.Queue(maxsize=_BUFFER)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self.action.on_start()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"event-loop-{self.name}"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._q.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.action.on_stop()
+
+    def post(self, event) -> None:
+        self._q.put(event)
+
+    def drain(self, timeout: float = 5.0) -> None:
+        """Wait until the queue is empty and the worker is idle (tests)."""
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._q.unfinished_tasks == 0:
+                return
+            time.sleep(0.01)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            event = self._q.get()
+            try:
+                if event is None:
+                    continue
+                try:
+                    follow_up = self.action.on_receive(event)
+                except Exception as e:  # noqa: BLE001
+                    self.action.on_error(e)
+                    follow_up = None
+                if follow_up is not None:
+                    self._q.put(follow_up)
+                    # account for the extra unfinished task we just created
+            finally:
+                self._q.task_done()

@@ -1,0 +1,28 @@
+"""Physical execution: operators over DeviceBatch streams.
+
+The DataFusion ``ExecutionPlan`` layer equivalent (the reference consumes it
+via the `ExecutionPlan` trait everywhere, e.g.
+ballista/rust/core/src/execution_plans/shuffle_writer.rs:142-292). Unlike
+the reference's CPU operators, every operator's compute here is an XLA
+program over statically-shaped DeviceBatches; operators are Python drivers
+that trace/jit device functions once per (schema, capacity) and stream
+batches through them.
+"""
+
+from ballista_tpu.exec.base import (
+    ExecutionPlan,
+    HashPartitioning,
+    Partitioning,
+    TaskContext,
+    UnknownPartitioning,
+)
+from ballista_tpu.exec.context import TpuContext
+
+__all__ = [
+    "ExecutionPlan",
+    "HashPartitioning",
+    "Partitioning",
+    "TaskContext",
+    "TpuContext",
+    "UnknownPartitioning",
+]

@@ -1,0 +1,476 @@
+"""Hash-aggregate operator (partial / final two-phase).
+
+ref: HashAggregateExecNode with AggregateMode PARTIAL/FINAL
+(ballista.proto:446-455 / 275-285, serde physical_plan mod.rs). TPU design:
+per input batch, one fused sort-based ``group_aggregate`` kernel produces a
+fixed-capacity partial state; partial states concat on device and a final
+merge pass re-aggregates with the merge ops. AVG decomposes into SUM+COUNT
+partials; COUNT merges by SUM (ops/aggregate.py AggOp.merge_op).
+
+The partial/final split is the distributed repartition boundary: partial
+outputs are what the reference's ShuffleWriter hash-partitions by group key
+(SURVEY.md §2.5 "Hash repartition").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.datatypes import DataType, Field, Schema
+from ballista_tpu.errors import PlanError
+from ballista_tpu.exec.base import (
+    ExecutionPlan,
+    TaskContext,
+    UnknownPartitioning,
+)
+from ballista_tpu.expr import logical as L
+from ballista_tpu.expr.physical import compile_expr
+from ballista_tpu.ops.aggregate import AggOp, group_aggregate, scalar_aggregate
+from ballista_tpu.ops.concat import concat_batches
+
+
+@dataclasses.dataclass(frozen=True)
+class StateSlot:
+    """One partial-state column: its AggOp and source column index in the
+    pre-projected input (or None for COUNT(*))."""
+
+    name: str
+    op: AggOp
+    src: int | None
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    """Decomposition of logical aggregate expressions into partial state
+    slots + final expressions over the merged state."""
+
+    group_names: tuple[str, ...]
+    slots: tuple[StateSlot, ...]
+    # final output: (output name, dtype, state slot indices, kind)
+    # kind: "id" -> slot value; "avg" -> slots[0]/slots[1]
+    finals: tuple[tuple[str, DataType, tuple[int, ...], str], ...]
+
+
+def decompose_aggregates(
+    group_exprs: list[L.Expr],
+    agg_exprs: list[L.Expr],
+    input_schema: Schema,
+) -> AggSpec:
+    slots: list[StateSlot] = []
+    finals: list[tuple[str, DataType, tuple[int, ...], str]] = []
+
+    def slot_for(op: AggOp, src: int | None, name: str) -> int:
+        for i, s in enumerate(slots):
+            if s.op == op and s.src == src:
+                return i
+        slots.append(StateSlot(name, op, src))
+        return len(slots) - 1
+
+    # pre-projection layout: group cols first, then distinct agg args
+    arg_index: dict[str, int] = {}
+    n_groups = len(group_exprs)
+
+    def arg_slot(e: L.Expr) -> int:
+        key = e.name()
+        if key not in arg_index:
+            arg_index[key] = n_groups + len(arg_index)
+        return arg_index[key]
+
+    for e in agg_exprs:
+        aggs = L.find_aggregates(e)
+        if len(aggs) != 1 or not aggs[0] is e:
+            raise PlanError(
+                f"aggregate expression {e.name()!r} must be a bare aggregate "
+                "(planner rewrites arithmetic over aggregates)"
+            )
+        a = e
+        out_dtype = a.data_type(input_schema)
+        if a.func == L.AggFunc.AVG:
+            src = arg_slot(a.arg)
+            i1 = slot_for(AggOp.SUM, src, f"{a.name()}#sum")
+            i2 = slot_for(AggOp.COUNT, src, f"{a.name()}#count")
+            finals.append((a.name(), out_dtype, (i1, i2), "avg"))
+        elif a.func == L.AggFunc.COUNT:
+            src = None if isinstance(a.arg, L.Wildcard) else arg_slot(a.arg)
+            i = slot_for(AggOp.COUNT, src, f"{a.name()}#count")
+            finals.append((a.name(), out_dtype, (i,), "id"))
+        else:
+            op = {
+                L.AggFunc.SUM: AggOp.SUM,
+                L.AggFunc.MIN: AggOp.MIN,
+                L.AggFunc.MAX: AggOp.MAX,
+            }[a.func]
+            src = arg_slot(a.arg)
+            i = slot_for(op, src, f"{a.name()}#{op.value}")
+            finals.append((a.name(), out_dtype, (i,), "id"))
+
+    return AggSpec(
+        group_names=tuple(g.name() for g in group_exprs),
+        slots=tuple(slots),
+        finals=tuple(finals),
+    )
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _ones_program(cap: int):
+    return jax.jit(lambda: jnp.ones(cap, dtype=jnp.int64))
+
+
+@functools.lru_cache(maxsize=None)
+def _state_batch_program(dtypes: tuple):
+    """GroupAggResult -> state-shaped DeviceBatch with target dtypes (one
+    cheap jitted cast/pack program per layout)."""
+
+    def f(res, state_schema):
+        cols = list(res.keys) + list(res.values)
+        nulls = list(res.key_nulls) + list(res.value_nulls)
+        cols = [
+            c.astype(f_.dtype.to_np()) if c.dtype != f_.dtype.to_np() else c
+            for c, f_ in zip(cols, state_schema)
+        ]
+        return DeviceBatch(
+            schema=state_schema,
+            columns=tuple(cols),
+            valid=res.valid,
+            nulls=tuple(nulls),
+            dictionaries={},
+        )
+
+    return jax.jit(f, static_argnames=("state_schema",))
+
+
+def _agg_arg_exprs(agg_exprs: list[L.Expr]) -> list[L.Expr]:
+    """Distinct aggregate argument expressions, in first-use order."""
+    seen: dict[str, L.Expr] = {}
+    for e in agg_exprs:
+        for a in L.find_aggregates(e):
+            if isinstance(a.arg, L.Wildcard):
+                continue
+            seen.setdefault(a.arg.name(), a.arg)
+    return list(seen.values())
+
+
+class HashAggregateExec(ExecutionPlan):
+    """mode='partial' emits group keys + state columns per input partition;
+    mode='final' merges partial outputs into final values (single output
+    partition unless fed by a hash repartition)."""
+
+    def __init__(
+        self,
+        input: ExecutionPlan,
+        group_exprs: list[L.Expr],
+        agg_exprs: list[L.Expr],
+        mode: str,  # "partial" | "final"
+        spec: AggSpec | None = None,
+        capacity: int | None = None,
+        planned_input_schema: Schema | None = None,
+    ) -> None:
+        super().__init__()
+        if mode not in ("partial", "final"):
+            raise PlanError(f"bad aggregate mode {mode}")
+        self.input = input
+        self.group_exprs = list(group_exprs)
+        self.agg_exprs = list(agg_exprs)
+        self.mode = mode
+        self.capacity = capacity
+        self._jit_cache: dict = {}
+        ins = input.schema()
+        # Schema the aggregate exprs were planned against (= the partial's
+        # input); carried through final mode for plan serde round-trips.
+        self.planned_input_schema = (
+            planned_input_schema if planned_input_schema is not None else ins
+        )
+        if mode == "partial":
+            self.spec = (
+                spec
+                if spec is not None
+                else decompose_aggregates(group_exprs, agg_exprs, ins)
+            )
+            # partial input pre-projection: groups then args
+            self._pre_exprs = list(group_exprs) + _agg_arg_exprs(agg_exprs)
+            pre_schema_fields = [
+                Field(e.name(), e.data_type(ins), e.nullable(ins))
+                for e in self._pre_exprs
+            ]
+            self._pre_schema = Schema(pre_schema_fields)
+            self._schema = self._partial_schema(self._pre_schema)
+        else:
+            if spec is None:
+                raise PlanError("final aggregate requires the partial's spec")
+            self.spec = spec
+            self._schema = self._final_schema(ins)
+
+    # -- schemas -------------------------------------------------------------
+    def _partial_schema(self, pre: Schema) -> Schema:
+        fields = [pre.fields[i] for i in range(len(self.spec.group_names))]
+        for s in self.spec.slots:
+            if s.op == AggOp.COUNT:
+                dt = DataType.INT64
+            else:
+                src_field = pre.fields[s.src]
+                dt = src_field.dtype
+                if s.op == AggOp.SUM:
+                    dt = (
+                        DataType.INT64
+                        if dt.is_integer or dt == DataType.BOOL
+                        else DataType.FLOAT64
+                        if dt.is_floating
+                        else dt
+                    )
+            fields.append(Field(s.name, dt, True))
+        return Schema(fields)
+
+    def _final_schema(self, partial: Schema) -> Schema:
+        ng = len(self.spec.group_names)
+        fields = list(partial.fields[:ng])
+        for name, dtype, _, _ in self.spec.finals:
+            fields.append(Field(name, dtype, True))
+        return Schema(fields)
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def output_partitioning(self):
+        if self.mode == "partial":
+            return self.input.output_partitioning()
+        return UnknownPartitioning(1)
+
+    def describe(self) -> str:
+        g = ", ".join(self.spec.group_names)
+        a = ", ".join(s.name for s in self.spec.slots)
+        return f"HashAggregateExec(mode={self.mode}): gby=[{g}], aggr=[{a}]"
+
+    # -- execution -----------------------------------------------------------
+    def _agg_capacity(self, ctx: TaskContext) -> int:
+        return self.capacity or ctx.config.agg_capacity()
+
+    def _run_group_agg(
+        self,
+        batch: DeviceBatch,
+        ops: list[AggOp],
+        n_groups: int,
+        cap: int,
+        from_state: bool,
+    ) -> DeviceBatch:
+        """One jitted group_aggregate pass -> state-shaped DeviceBatch.
+        ``from_state``: value columns are already state slots (merge pass);
+        otherwise they come from the pre-projection via each slot's ``src``
+        (first partial pass). The overflow flag is checked host-side after
+        the jitted call."""
+        # group_aggregate host-composes cached sort passes + a jitted
+        # finisher — do NOT wrap it in another jit (that would re-inline the
+        # sorts into one slow-compiling program).
+        key_cols = [batch.columns[i] for i in range(n_groups)]
+        key_nulls = [batch.nulls[i] for i in range(n_groups)]
+        val_cols, val_nulls = [], []
+        for j, s in enumerate(self.spec.slots):
+            if from_state:
+                idx = n_groups + j
+                val_cols.append(batch.columns[idx])
+                val_nulls.append(batch.nulls[idx])
+            elif s.src is None:  # COUNT(*): count valid rows
+                val_cols.append(_ones_program(batch.capacity)())
+                val_nulls.append(None)
+            else:
+                val_cols.append(batch.columns[s.src])
+                val_nulls.append(batch.nulls[s.src])
+        res = group_aggregate(
+            key_cols, key_nulls, batch.valid, val_cols, val_nulls,
+            list(ops), cap,
+        )
+        res.check_overflow()
+        state_schema = batch.schema if from_state else self._schema
+        dtypes = tuple(f.dtype.value for f in state_schema)
+        out = _state_batch_program(dtypes)(res, state_schema)
+        return DeviceBatch(
+            schema=out.schema,
+            columns=out.columns,
+            valid=out.valid,
+            nulls=out.nulls,
+            dictionaries={
+                k: v
+                for k, v in batch.dictionaries.items()
+                if any(
+                    f.name == k and f.dtype == DataType.STRING
+                    for f in state_schema
+                )
+            },
+        )
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        cap = self._agg_capacity(ctx)
+        n_groups = len(self.spec.group_names)
+        if self.mode == "partial":
+            yield from self._execute_partial(partition, ctx, cap, n_groups)
+        else:
+            yield from self._execute_final(partition, ctx, cap, n_groups)
+
+    def _execute_partial(
+        self, partition: int, ctx: TaskContext, cap: int, n_groups: int
+    ) -> Iterator[DeviceBatch]:
+        from ballista_tpu.exec.pipeline import ProjectionExec
+
+        pre = ProjectionExec(self.input, self._pre_exprs)
+        ops = [s.op for s in self.spec.slots]
+
+        if n_groups == 0:
+            # scalar aggregate: one-row state per partition
+            states: list[DeviceBatch] = []
+            for b in pre.execute(partition, ctx):
+                with self.metrics.time("agg_time"):
+                    states.append(self._scalar_state(b))
+            if not states:
+                return
+            merged = concat_batches(states) if len(states) > 1 else states[0]
+            yield merged
+            return
+
+        partials: list[DeviceBatch] = []
+        for b in pre.execute(partition, ctx):
+            with self.metrics.time("agg_time"):
+                partials.append(
+                    self._run_group_agg(b, ops, n_groups, cap, from_state=False)
+                )
+            self.metrics.add("input_batches")
+        if not partials:
+            return
+        if len(partials) == 1:
+            yield partials[0]
+            return
+        # fold this partition's partials once more (merge ops) to bound
+        # shuffle volume
+        merged = concat_batches(partials)
+        merge_ops = [s.op.merge_op for s in self.spec.slots]
+        yield self._run_group_agg(merged, merge_ops, n_groups, cap, from_state=True)
+
+    def _scalar_state(self, b: DeviceBatch) -> DeviceBatch:
+        val_cols, val_nulls = [], []
+        for s in self.spec.slots:
+            if s.src is None:
+                val_cols.append(jnp.ones(b.capacity, dtype=jnp.int64))
+                val_nulls.append(None)
+            else:
+                val_cols.append(b.columns[s.src])
+                val_nulls.append(b.nulls[s.src])
+        outs, nulls = scalar_aggregate(
+            b.valid, val_cols, val_nulls, [s.op for s in self.spec.slots]
+        )
+        import numpy as np
+
+        cols = []
+        for v, f in zip(outs, self._schema):
+            arr = jnp.zeros(2048, dtype=f.dtype.to_np()).at[0].set(
+                v.astype(f.dtype.to_np())
+            )
+            cols.append(arr)
+        valid = jnp.zeros(2048, dtype=bool).at[0].set(True)
+        null_masks = []
+        for nl in nulls:
+            if nl is None:
+                null_masks.append(None)
+            else:
+                null_masks.append(jnp.zeros(2048, dtype=bool).at[0].set(nl))
+        return DeviceBatch(
+            schema=self._schema,
+            columns=tuple(cols),
+            valid=valid,
+            nulls=tuple(null_masks),
+            dictionaries={},
+        )
+
+    def _execute_final(
+        self, partition: int, ctx: TaskContext, cap: int, n_groups: int
+    ) -> Iterator[DeviceBatch]:
+        states = []
+        part = self.input.output_partitioning()
+        for p in range(part.n):
+            states.extend(self.input.execute(p, ctx))
+        if not states:
+            return
+        merge_ops = [s.op.merge_op for s in self.spec.slots]
+        if n_groups == 0:
+            merged = concat_batches(states) if len(states) > 1 else states[0]
+            outs, nulls = scalar_aggregate(
+                merged.valid,
+                [merged.columns[i] for i in range(len(self.spec.slots))],
+                [merged.nulls[i] for i in range(len(self.spec.slots))],
+                merge_ops,
+            )
+            yield self._finalize_scalar(outs, nulls)
+            return
+        merged = concat_batches(states) if len(states) > 1 else states[0]
+        with self.metrics.time("merge_time"):
+            state = self._run_group_agg(
+                merged, merge_ops, n_groups, cap, from_state=True
+            )
+        yield self._finalize(state, n_groups)
+
+    def _finalize(self, state: DeviceBatch, n_groups: int) -> DeviceBatch:
+        cols = list(state.columns[:n_groups])
+        nulls = list(state.nulls[:n_groups])
+        for name, dtype, idxs, kind in self.spec.finals:
+            if kind == "avg":
+                s = state.columns[n_groups + idxs[0]]
+                c = state.columns[n_groups + idxs[1]]
+                vals = s.astype(jnp.float64) / jnp.maximum(c, 1).astype(
+                    jnp.float64
+                )
+                nl = c == 0
+                base_null = state.nulls[n_groups + idxs[0]]
+                if base_null is not None:
+                    nl = nl | base_null
+            else:
+                vals = state.columns[n_groups + idxs[0]]
+                nl = state.nulls[n_groups + idxs[0]]
+            want = dtype.to_np()
+            if vals.dtype != want:
+                vals = vals.astype(want)
+            cols.append(vals)
+            nulls.append(nl)
+        return DeviceBatch(
+            schema=self._schema,
+            columns=tuple(cols),
+            valid=state.valid,
+            nulls=tuple(nulls),
+            dictionaries=dict(state.dictionaries),
+        )
+
+    def _finalize_scalar(self, outs, nulls) -> DeviceBatch:
+        cap = 2048
+        cols, null_masks = [], []
+        n_slots = len(self.spec.slots)
+        for name, dtype, idxs, kind in self.spec.finals:
+            if kind == "avg":
+                s, c = outs[idxs[0]], outs[idxs[1]]
+                v = s.astype(jnp.float64) / jnp.maximum(c, 1).astype(jnp.float64)
+                nl = c == 0
+            else:
+                v = outs[idxs[0]]
+                nl = nulls[idxs[0]]
+            arr = jnp.zeros(cap, dtype=dtype.to_np()).at[0].set(
+                v.astype(dtype.to_np())
+            )
+            cols.append(arr)
+            if nl is None:
+                null_masks.append(None)
+            else:
+                null_masks.append(jnp.zeros(cap, dtype=bool).at[0].set(nl))
+        valid = jnp.zeros(cap, dtype=bool).at[0].set(True)
+        return DeviceBatch(
+            schema=self._schema,
+            columns=tuple(cols),
+            valid=valid,
+            nulls=tuple(null_masks),
+            dictionaries={},
+        )

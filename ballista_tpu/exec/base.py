@@ -1,0 +1,130 @@
+"""ExecutionPlan protocol, partitioning, task context, metrics.
+
+Mirrors the slice of DataFusion's physical-plan API the reference depends
+on: `schema()`, `output_partitioning()`, `execute(partition)` streaming
+record batches, and per-operator metrics
+(`ExecutionPlanMetricsSet`, see SURVEY.md §5 Tracing — the reference's
+ShuffleWriterExec records write_time/repart_time at shuffle_writer.rs:80-106).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Iterator
+
+from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.datatypes import Schema
+from ballista_tpu.expr import logical as L
+
+
+@dataclasses.dataclass(frozen=True)
+class UnknownPartitioning:
+    n: int
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class HashPartitioning:
+    exprs: tuple[L.Expr, ...]
+    n: int
+
+
+Partitioning = UnknownPartitioning | HashPartitioning
+
+
+@dataclasses.dataclass
+class TaskContext:
+    """Per-task runtime state (the reference builds one from session props at
+    executor/src/execution_loop.rs:146-167)."""
+
+    config: BallistaConfig = dataclasses.field(default_factory=BallistaConfig)
+    session_id: str = ""
+    job_id: str = ""
+    work_dir: str = ""
+
+
+class Metrics:
+    """Per-operator counters/timers (ref: DataFusion metrics sets)."""
+
+    def __init__(self) -> None:
+        self.counters: dict[str, int] = {}
+        self.timers: dict[str, float] = {}
+
+    def add(self, name: str, v: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + v
+
+    def time(self, name: str):
+        return _Timer(self, name)
+
+    def summary(self) -> dict[str, float]:
+        out: dict[str, float] = dict(self.counters)
+        out.update({k: round(v, 6) for k, v in self.timers.items()})
+        return out
+
+
+class _Timer:
+    def __init__(self, m: Metrics, name: str):
+        self.m = m
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.m.timers[self.name] = self.m.timers.get(self.name, 0.0) + (
+            time.perf_counter() - self.t0
+        )
+        return False
+
+
+class ExecutionPlan:
+    """Base physical operator. Subclasses implement ``execute`` returning an
+    iterator of DeviceBatch for one output partition."""
+
+    def __init__(self) -> None:
+        self.metrics = Metrics()
+
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def children(self) -> list["ExecutionPlan"]:
+        return []
+
+    def output_partitioning(self) -> Partitioning:
+        return UnknownPartitioning(1)
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        raise NotImplementedError
+
+    # -- display -------------------------------------------------------------
+    def describe(self) -> str:
+        return type(self).__name__
+
+    def display(self, with_metrics: bool = False) -> str:
+        lines: list[str] = []
+
+        def walk(node: "ExecutionPlan", depth: int) -> None:
+            line = "  " * depth + node.describe()
+            if with_metrics and (node.metrics.counters or node.metrics.timers):
+                line += f"  metrics={node.metrics.summary()}"
+            lines.append(line)
+            for c in node.children():
+                walk(c, depth + 1)
+
+        walk(self, 0)
+        return "\n".join(lines)
+
+
+def execute_to_batches(
+    plan: ExecutionPlan, ctx: TaskContext
+) -> list[DeviceBatch]:
+    """Run every output partition of a plan and collect the batches (the
+    reference's ``collect_stream``, core/src/utils.rs:95)."""
+    part = plan.output_partitioning()
+    n = part.n if isinstance(part, UnknownPartitioning) else part.n
+    out: list[DeviceBatch] = []
+    for p in range(n):
+        out.extend(plan.execute(p, ctx))
+    return out

@@ -1,0 +1,244 @@
+"""TpuContext: the single-process engine entry point.
+
+The engine-side equivalent of DataFusion's SessionContext (which the
+reference's BallistaContext builds on, ballista/rust/client/src/context.rs).
+The distributed client context (``ballista_tpu.client``) wraps a scheduler
+instead but exposes the same surface; this context is also what executors
+use to run stage plans locally.
+"""
+
+from __future__ import annotations
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.parquet as papq
+
+from ballista_tpu.columnar.arrow_interop import (
+    batch_to_arrow,
+    schema_from_arrow,
+)
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.datatypes import Schema
+from ballista_tpu.errors import PlanError, SqlError
+from ballista_tpu.exec.base import (
+    ExecutionPlan,
+    TaskContext,
+    UnknownPartitioning,
+)
+from ballista_tpu.exec.planner import PhysicalPlanner, TableProvider
+from ballista_tpu.exec.scan import CsvScanExec, MemoryScanExec, ParquetScanExec
+from ballista_tpu.plan.logical import LogicalPlan
+from ballista_tpu.plan.optimizer import optimize
+from ballista_tpu.sql import ast
+from ballista_tpu.sql.parser import parse_sql
+from ballista_tpu.sql.planner import Catalog, SqlPlanner
+from ballista_tpu.tpch import all_schemas  # noqa: F401  (re-export convenience)
+
+
+class _Registered:
+    def __init__(self, kind: str, schema: Schema, **kw):
+        self.kind = kind  # memory | csv | parquet
+        self.schema = schema
+        self.kw = kw
+
+
+class TpuContext(Catalog, TableProvider):
+    """Register tables, run SQL, collect Arrow results."""
+
+    def __init__(self, config: BallistaConfig | None = None):
+        self.config = config or BallistaConfig()
+        self.tables: dict[str, _Registered] = {}
+
+    # -- registration (ref context.rs read_csv/read_parquet/register_*) ------
+    def register_table(self, name: str, table: pa.Table) -> None:
+        self.tables[name] = _Registered(
+            "memory", schema_from_arrow(table.schema), table=table
+        )
+
+    def register_csv(
+        self,
+        name: str,
+        path: str,
+        schema: Schema | None = None,
+        has_header: bool = True,
+        delimiter: str = ",",
+    ) -> None:
+        if schema is None:
+            t = pacsv.read_csv(
+                path,
+                parse_options=pacsv.ParseOptions(delimiter=delimiter),
+            )
+            schema = schema_from_arrow(t.schema)
+        self.tables[name] = _Registered(
+            "csv", schema, path=path, has_header=has_header, delimiter=delimiter
+        )
+
+    def register_parquet(self, name: str, path: str) -> None:
+        schema = schema_from_arrow(papq.read_schema(path))
+        self.tables[name] = _Registered("parquet", schema, path=path)
+
+    def deregister_table(self, name: str) -> None:
+        self.tables.pop(name, None)
+
+    # -- Catalog / TableProvider ---------------------------------------------
+    def schema_of(self, table: str) -> Schema:
+        if table not in self.tables:
+            raise PlanError(f"table {table!r} not found")
+        return self.tables[table].schema
+
+    def source_of(self, table: str):
+        r = self.tables.get(table)
+        if r is None or r.kind == "memory":
+            return None
+        if r.kind == "csv":
+            return ("csv", r.kw["path"], r.kw["has_header"], r.kw["delimiter"])
+        return ("parquet", r.kw["path"], False, ",")
+
+    def scan(
+        self, table: str, projection: list[str] | None, partitions: int
+    ) -> ExecutionPlan:
+        r = self.tables.get(table)
+        if r is None:
+            raise PlanError(f"table {table!r} not found")
+        if r.kind == "memory":
+            return MemoryScanExec(r.kw["table"], r.schema, projection, partitions)
+        if r.kind == "csv":
+            return CsvScanExec(
+                r.kw["path"], r.schema, r.kw["has_header"], r.kw["delimiter"],
+                projection, partitions,
+            )
+        return ParquetScanExec(r.kw["path"], r.schema, projection, partitions)
+
+    # -- SQL -----------------------------------------------------------------
+    def sql_to_logical(self, sql: str) -> LogicalPlan:
+        stmt = parse_sql(sql)
+        if not isinstance(stmt, (ast.Select, ast.SetOp)):
+            raise SqlError("only queries produce logical plans; use sql()")
+        return SqlPlanner(self).plan(stmt)
+
+    def create_physical_plan(self, logical: LogicalPlan) -> ExecutionPlan:
+        optimized = optimize(logical)
+        partitions = self.config.default_shuffle_partitions()
+        return PhysicalPlanner(self, partitions).plan(optimized)
+
+    def sql(self, sql: str) -> "DataFrame":
+        stmt = parse_sql(sql)
+        if isinstance(stmt, ast.CreateExternalTable):
+            self._create_external_table(stmt)
+            return DataFrame.empty_ok(self)
+        if isinstance(stmt, ast.DropTable):
+            if stmt.name not in self.tables and not stmt.if_exists:
+                raise PlanError(f"table {stmt.name!r} not found")
+            self.deregister_table(stmt.name)
+            return DataFrame.empty_ok(self)
+        if isinstance(stmt, ast.ShowTables):
+            t = pa.table({"table_name": pa.array(sorted(self.tables))})
+            return DataFrame.from_arrow(self, t)
+        if isinstance(stmt, ast.ShowColumns):
+            schema = self.schema_of(stmt.table)
+            t = pa.table(
+                {
+                    "column_name": pa.array([f.name for f in schema]),
+                    "data_type": pa.array([f.dtype.value for f in schema]),
+                    "nullable": pa.array([f.nullable for f in schema]),
+                }
+            )
+            return DataFrame.from_arrow(self, t)
+        if isinstance(stmt, ast.Explain):
+            logical = SqlPlanner(self).plan(stmt.query)
+            optimized = optimize(logical)
+            rows = [
+                ("logical_plan", logical.display()),
+                ("optimized_plan", optimized.display()),
+            ]
+            if stmt.verbose:
+                phys = PhysicalPlanner(
+                    self, self.config.default_shuffle_partitions()
+                ).plan(optimized)
+                rows.append(("physical_plan", phys.display()))
+            t = pa.table(
+                {
+                    "plan_type": pa.array([r[0] for r in rows]),
+                    "plan": pa.array([r[1] for r in rows]),
+                }
+            )
+            return DataFrame.from_arrow(self, t)
+        if isinstance(stmt, (ast.Select, ast.SetOp)):
+            return DataFrame(self, SqlPlanner(self).plan(stmt))
+        raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    def _create_external_table(self, stmt: ast.CreateExternalTable) -> None:
+        if stmt.name in self.tables:
+            if stmt.if_not_exists:
+                return
+            raise PlanError(f"table {stmt.name!r} already exists")
+        schema = None
+        if stmt.columns is not None:
+            from ballista_tpu.datatypes import Field
+
+            schema = Schema(
+                [Field(c.name, c.dtype, c.nullable) for c in stmt.columns]
+            )
+        if stmt.stored_as == "csv":
+            self.register_csv(
+                stmt.name, stmt.location, schema, stmt.has_header, stmt.delimiter
+            )
+        else:
+            self.register_parquet(stmt.name, stmt.location)
+
+
+class DataFrame:
+    """Lazy query handle (ref: DataFusion DataFrame via BallistaContext)."""
+
+    def __init__(self, ctx: TpuContext, logical: LogicalPlan):
+        self.ctx = ctx
+        self.logical = logical
+        self._const: pa.Table | None = None
+
+    @classmethod
+    def from_arrow(cls, ctx: TpuContext, table: pa.Table) -> "DataFrame":
+        df = cls.__new__(cls)
+        df.ctx = ctx
+        df.logical = None
+        df._const = table
+        return df
+
+    @classmethod
+    def empty_ok(cls, ctx: TpuContext) -> "DataFrame":
+        return cls.from_arrow(ctx, pa.table({"result": pa.array(["ok"])}))
+
+    def collect(self) -> pa.Table:
+        if self._const is not None:
+            return self._const
+        phys = self.ctx.create_physical_plan(self.logical)
+        ctx = TaskContext(config=self.ctx.config)
+        part = phys.output_partitioning()
+        n = part.n if isinstance(part, UnknownPartitioning) else part.n
+        record_batches = []
+        for p in range(n):
+            for b in phys.execute(p, ctx):
+                rb = batch_to_arrow(b)
+                if rb.num_rows:
+                    record_batches.append(rb)
+        if not record_batches:
+            from ballista_tpu.columnar.arrow_interop import schema_to_arrow
+
+            return pa.table(
+                {
+                    f.name: pa.array([], type=t.type)
+                    for f, t in zip(
+                        phys.schema(), schema_to_arrow(phys.schema())
+                    )
+                }
+            )
+        return pa.Table.from_batches(record_batches)
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    def show(self, limit: int = 20) -> None:
+        t = self.collect()
+        print(t.slice(0, limit).to_pandas().to_string(index=False))
+
+    def explain(self) -> str:
+        return optimize(self.logical).display() if self.logical else "<const>"

@@ -1,0 +1,442 @@
+"""Hash-join operator (COLLECT build side + streamed probe).
+
+ref: HashJoinExecNode with PartitionMode COLLECT_LEFT / PARTITIONED
+(ballista.proto:474-487, serde physical_plan mod.rs:438-523). Here the
+build side is always collected (broadcast within a process; the distributed
+planner repartitions both sides first for PARTITIONED mode), sorted once by
+packed key, and probed with the vectorized binary-search kernel.
+
+Build-side choice: the preserved/probe side is fixed for LEFT/SEMI/ANTI
+(the left input is probe); for INNER the operator builds the right side and
+falls back to building the left if the right has duplicate keys (PK-FK
+detection at runtime, since there are no table statistics yet).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.columnar.dict_util import merge_dictionaries, remap_codes
+from ballista_tpu.datatypes import DataType, Field, Schema
+from ballista_tpu.errors import ExecutionError, PlanError
+from ballista_tpu.exec.base import ExecutionPlan, TaskContext
+from ballista_tpu.expr import logical as L
+from ballista_tpu.expr.physical import compile_expr
+from ballista_tpu.ops.compact import compact
+from ballista_tpu.ops.concat import concat_batches
+from ballista_tpu.ops.join import JoinSide, build_side, probe_side
+from ballista_tpu.plan.logical import JoinType
+
+
+def _collect(plan: ExecutionPlan, ctx: TaskContext) -> DeviceBatch:
+    batches = []
+    part = plan.output_partitioning()
+    for p in range(part.n):
+        batches.extend(plan.execute(p, ctx))
+    if not batches:
+        return DeviceBatch.empty(plan.schema())
+    return concat_batches(batches)
+
+
+# build_side host-composes cached sort passes (wrapping it in another jit
+# would re-inline the sorts into one slow-compiling program — don't); the
+# probe is a single fast-compiling program per shape.
+@functools.lru_cache(maxsize=None)
+def _jit_probe(probe_keys: tuple, kind: JoinSide):
+    return jax.jit(
+        lambda bt, pb: probe_side(bt, pb, list(probe_keys), kind)
+    )
+
+
+class HashJoinExec(ExecutionPlan):
+    def __init__(
+        self,
+        left: ExecutionPlan,
+        right: ExecutionPlan,
+        on: list[tuple[L.Expr, L.Expr]],
+        join_type: JoinType,
+        filter: L.Expr | None = None,
+    ) -> None:
+        super().__init__()
+        self.left = left
+        self.right = right
+        self.on = list(on)
+        self.join_type = join_type
+        self.filter = filter
+        self._filtered_probe_cache: dict = {}
+        ls, rs = left.schema(), right.schema()
+        for a, b in self.on:
+            if not (isinstance(a, L.Column) and isinstance(b, L.Column)):
+                raise PlanError("join keys must be columns (planner projects)")
+        if join_type in (JoinType.SEMI, JoinType.ANTI):
+            self._schema = ls
+        elif join_type == JoinType.LEFT:
+            self._schema = ls.join(
+                Schema([Field(f.name, f.dtype, True) for f in rs])
+            )
+        elif join_type == JoinType.INNER:
+            self._schema = ls.join(rs)
+        else:
+            raise PlanError(f"join type {join_type} not supported on device yet")
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.left, self.right]
+
+    def output_partitioning(self):
+        return self.left.output_partitioning()
+
+    def describe(self) -> str:
+        on = ", ".join(f"{a.name()} = {b.name()}" for a, b in self.on)
+        f = f", filter={self.filter.name()}" if self.filter is not None else ""
+        return f"HashJoinExec({self.join_type.value}): on=[{on}]{f}"
+
+    # -- dictionaries ---------------------------------------------------------
+    def _unify_key_dicts(
+        self, build: DeviceBatch, probe: DeviceBatch,
+        build_keys: list[int], probe_keys: list[int],
+    ) -> tuple[DeviceBatch, DeviceBatch]:
+        """String join keys must share a dictionary; remap both sides."""
+        for bi, pi in zip(build_keys, probe_keys):
+            bf = build.schema.fields[bi]
+            pf = probe.schema.fields[pi]
+            if bf.dtype != DataType.STRING and pf.dtype != DataType.STRING:
+                continue
+            bd = build.dictionaries.get(bf.name)
+            pd_ = probe.dictionaries.get(pf.name)
+            if bd is None or pd_ is None:
+                raise ExecutionError(
+                    f"string join key {bf.name!r} missing dictionary"
+                )
+            if bd.values == pd_.values:
+                continue
+            merged, rb, rp = merge_dictionaries(bd, pd_)
+            bcols = list(build.columns)
+            bcols[bi] = remap_codes(build.columns[bi], rb)
+            bdicts = dict(build.dictionaries)
+            bdicts[bf.name] = merged
+            build = DeviceBatch(
+                schema=build.schema, columns=tuple(bcols), valid=build.valid,
+                nulls=build.nulls, dictionaries=bdicts,
+            )
+            pcols = list(probe.columns)
+            pcols[pi] = remap_codes(probe.columns[pi], rp)
+            pdicts = dict(probe.dictionaries)
+            pdicts[pf.name] = merged
+            probe = DeviceBatch(
+                schema=probe.schema, columns=tuple(pcols), valid=probe.valid,
+                nulls=probe.nulls, dictionaries=pdicts,
+            )
+        return build, probe
+
+    # -- execution ------------------------------------------------------------
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        ls, rs = self.left.schema(), self.right.schema()
+        left_keys = [L.resolve_field_index(ls, a.cname) for a, _ in self.on]
+        right_keys = [L.resolve_field_index(rs, b.cname) for _, b in self.on]
+
+        if self.join_type == JoinType.INNER:
+            yield from self._execute_inner(partition, ctx, left_keys, right_keys)
+            return
+
+        # LEFT/SEMI/ANTI: left side is preserved => left probes, right builds.
+        with self.metrics.time("build_time"):
+            build_batch = _collect(self.right, ctx)
+        kind = {
+            JoinType.LEFT: JoinSide.LEFT,
+            JoinType.SEMI: JoinSide.SEMI,
+            JoinType.ANTI: JoinSide.ANTI,
+        }[self.join_type]
+        bt = None
+        for b in self.left.execute(partition, ctx):
+            bb, pb = self._unify_key_dicts(build_batch, b, right_keys, left_keys)
+            if bt is None or bb is not build_batch:
+                # rebuild only when dictionary remapping changed the build
+                with self.metrics.time("build_time"):
+                    bt = build_side(bb, right_keys)
+                bt.check_unique()
+                build_batch = bb
+            out = self._probe_with_filter(bt, pb, left_keys, kind)
+            self.metrics.add("output_batches")
+            yield out
+
+    def _execute_inner(
+        self, partition, ctx, left_keys, right_keys
+    ) -> Iterator[DeviceBatch]:
+        """INNER: build the right side; if it has duplicate keys, build the
+        left instead (the kernel needs a unique PK side; there are no table
+        statistics yet, so detect at runtime) and restore column order."""
+        with self.metrics.time("build_time"):
+            right_batch = _collect(self.right, ctx)
+
+        iter_first = iter(self.left.execute(partition, ctx))
+        first = next(iter_first, None)
+        if first is None:
+            return
+
+        bb, pb = self._unify_key_dicts(right_batch, first, right_keys, left_keys)
+        with self.metrics.time("build_time"):
+            bt = build_side(bb, right_keys)
+        if bool(bt.has_dups) or bool(bt.run_overflow):
+            # flip: build left (collect all partitions), probe right. The
+            # flip decision is deterministic across partitions, so emit all
+            # output from partition 0 and nothing elsewhere.
+            if partition != 0:
+                return
+            with self.metrics.time("build_time"):
+                left_batch = _collect(self.left, ctx)
+            build_keys, probe_keys = left_keys, right_keys
+            build_is_right = False
+            probes = (
+                b
+                for p in range(self.right.output_partitioning().n)
+                for b in self.right.execute(p, ctx)
+            )
+            base, bt = left_batch, None
+        else:
+            build_keys, probe_keys = right_keys, left_keys
+            build_is_right = True
+
+            def _rest():
+                yield first
+                yield from iter_first
+
+            probes = _rest()
+            base = bb
+
+        for b in probes:
+            bb2, pb = self._unify_key_dicts(base, b, build_keys, probe_keys)
+            if bt is None or bb2 is not base:
+                with self.metrics.time("build_time"):
+                    bt = build_side(bb2, build_keys)
+                bt.check_unique()
+                base = bb2
+            joined = self._probe_with_filter(bt, pb, probe_keys, JoinSide.INNER)
+            out = self._restore_column_order(joined, pb, bt.batch, build_is_right)
+            self.metrics.add("output_batches")
+            yield out
+
+    def _probe_with_filter(
+        self, bt, probe: DeviceBatch, probe_keys: list[int], kind: JoinSide
+    ) -> DeviceBatch:
+        """Probe (jitted); apply the residual join filter to match
+        semantics."""
+        if self.filter is None:
+            with self.metrics.time("probe_time"):
+                return _jit_probe(tuple(probe_keys), kind)(bt, probe)
+        key = (tuple(probe_keys), kind)
+        fn = self._filtered_probe_cache.get(key)
+        if fn is None:
+            filt = self.filter
+            pk = list(probe_keys)
+
+            def run(bt, probe):
+                # Residual filters see probe ++ build columns: join LEFT-like
+                # first, evaluate, then adjust validity per join kind.
+                joined = probe_side(bt, probe, pk, JoinSide.LEFT)
+                matched = probe_side(bt, probe, pk, JoinSide.INNER).valid
+                phys = compile_expr(filt, joined.schema)
+                cv = phys.evaluate(joined)
+                passes = cv.values.astype(bool)
+                if cv.nulls is not None:
+                    passes = passes & ~cv.nulls
+                full_match = matched & passes
+                if kind == JoinSide.SEMI:
+                    return probe.with_valid(probe.valid & full_match)
+                if kind == JoinSide.ANTI:
+                    return probe.with_valid(probe.valid & ~full_match)
+                if kind == JoinSide.INNER:
+                    return joined.with_valid(full_match)
+                # LEFT: keep probe rows; null the build side on no full match
+                bcols_start = len(probe.schema)
+                nulls = list(joined.nulls)
+                for i in range(bcols_start, len(joined.schema)):
+                    m = nulls[i]
+                    miss = ~full_match
+                    nulls[i] = miss if m is None else (m | miss)
+                return DeviceBatch(
+                    schema=joined.schema,
+                    columns=joined.columns,
+                    valid=probe.valid,
+                    nulls=tuple(nulls),
+                    dictionaries=dict(joined.dictionaries),
+                )
+
+            fn = jax.jit(run)
+            self._filtered_probe_cache[key] = fn
+        with self.metrics.time("probe_time"):
+            return fn(bt, probe)
+
+    def _restore_column_order(
+        self,
+        joined: DeviceBatch,
+        probe: DeviceBatch,
+        build: DeviceBatch,
+        build_is_right: bool,
+    ) -> DeviceBatch:
+        """probe_side outputs probe++build; the plan schema is left++right."""
+        if build_is_right:
+            return DeviceBatch(
+                schema=self._schema,
+                columns=joined.columns,
+                valid=joined.valid,
+                nulls=joined.nulls,
+                dictionaries=self._rename_dicts(joined, self._schema),
+            )
+        # joined = right ++ left; reorder to left ++ right
+        n_probe = len(probe.schema)
+        cols = joined.columns[n_probe:] + joined.columns[:n_probe]
+        nulls = joined.nulls[n_probe:] + joined.nulls[:n_probe]
+        out = DeviceBatch(
+            schema=self._schema,
+            columns=cols,
+            valid=joined.valid,
+            nulls=nulls,
+            dictionaries=self._rename_dicts(joined, self._schema),
+        )
+        return out
+
+    @staticmethod
+    def _rename_dicts(joined: DeviceBatch, schema: Schema):
+        # dictionaries are name-keyed; schema order changes don't affect them
+        return dict(joined.dictionaries)
+
+
+class UnionExec(ExecutionPlan):
+    """ref: UnionExecNode — concatenates child partitions positionally."""
+
+    def __init__(self, inputs: list[ExecutionPlan]) -> None:
+        super().__init__()
+        self.inputs = list(inputs)
+        self._schema = inputs[0].schema()
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def children(self) -> list[ExecutionPlan]:
+        return list(self.inputs)
+
+    def output_partitioning(self):
+        from ballista_tpu.exec.base import UnknownPartitioning
+
+        return UnknownPartitioning(
+            sum(i.output_partitioning().n for i in self.inputs)
+        )
+
+    def describe(self) -> str:
+        return f"UnionExec: {len(self.inputs)} inputs"
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        p = partition
+        for child in self.inputs:
+            n = child.output_partitioning().n
+            if p < n:
+                for b in child.execute(p, ctx):
+                    if b.schema.names != self._schema.names:
+                        # positional union: rename columns to first input
+                        b = DeviceBatch(
+                            schema=self._schema,
+                            columns=b.columns,
+                            valid=b.valid,
+                            nulls=b.nulls,
+                            dictionaries={
+                                self._schema.fields[
+                                    b.schema.index_of(k)
+                                ].name: v
+                                for k, v in b.dictionaries.items()
+                            },
+                        )
+                    yield b
+                return
+            p -= n
+        raise ExecutionError(f"union partition {partition} out of range")
+
+
+class EmptyExec(ExecutionPlan):
+    """ref: EmptyExecNode (produce_one_row for SELECT <literals>)."""
+
+    def __init__(self, produce_one_row: bool, schema: Schema) -> None:
+        super().__init__()
+        self.produce_one_row = produce_one_row
+        self._schema = schema
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def describe(self) -> str:
+        return f"EmptyExec: rows={1 if self.produce_one_row else 0}"
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        import numpy as np
+
+        if not self.produce_one_row:
+            yield DeviceBatch.empty(self._schema)
+            return
+        arrays = [np.zeros(1, f.dtype.to_np()) for f in self._schema]
+        yield DeviceBatch.from_host(self._schema, arrays, num_rows=1)
+
+
+class CrossJoinExec(ExecutionPlan):
+    """Cross join where one side is a single-row relation (the shape the
+    optimizer leaves behind for uncorrelated scalar subqueries, q11/q22):
+    the single row's columns broadcast onto every row of the other side.
+    General many-x-many cross joins are rejected (nothing in TPC-H needs
+    them and they explode on static shapes)."""
+
+    def __init__(self, left: ExecutionPlan, right: ExecutionPlan) -> None:
+        super().__init__()
+        self.left = left
+        self.right = right
+        self._schema = left.schema().join(right.schema())
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.left, self.right]
+
+    def output_partitioning(self):
+        return self.left.output_partitioning()
+
+    def describe(self) -> str:
+        return "CrossJoinExec(broadcast-1-row)"
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        one = _collect(self.right, ctx)
+        one = compact(one)
+        n = one.num_rows()
+        if n != 1:
+            raise ExecutionError(
+                f"CrossJoinExec supports a 1-row broadcast side, got {n} "
+                "rows; general cross joins are not supported on device"
+            )
+        r_schema = self.right.schema()
+        for b in self.left.execute(partition, ctx):
+            cols = list(b.columns)
+            nulls = list(b.nulls)
+            dicts = dict(b.dictionaries)
+            for i, f in enumerate(r_schema):
+                v = one.columns[i][0]
+                cols.append(jnp.broadcast_to(v, (b.capacity,)))
+                m = one.nulls[i]
+                if m is None:
+                    nulls.append(None)
+                else:
+                    nulls.append(jnp.broadcast_to(m[0], (b.capacity,)))
+                d = one.dictionaries.get(f.name)
+                if d is not None:
+                    dicts[f.name] = d
+            yield DeviceBatch(
+                schema=self._schema,
+                columns=tuple(cols),
+                valid=b.valid,
+                nulls=tuple(nulls),
+                dictionaries=dicts,
+            )

@@ -1,0 +1,184 @@
+"""Row-pipeline operators: Filter, Projection, and batch-function fusion.
+
+Filter and Projection are pure per-batch device functions; each operator
+jits its function once and streams batches through. Because filters only
+clear validity bits and projections only swap column sets, XLA fuses a
+Filter->Projection->partial-Aggregate chain into one program when the
+distributed planner later compiles whole stages (SURVEY.md §7 "Stage DAG vs
+jit fusion boundary").
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator
+
+import jax
+
+from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.datatypes import Field, Schema
+from ballista_tpu.exec.base import ExecutionPlan, TaskContext
+from ballista_tpu.expr import logical as L
+from ballista_tpu.expr.physical import compile_expr
+
+
+class FilterExec(ExecutionPlan):
+    """ref: FilterExecNode (ballista.proto:457-460). Clears validity bits;
+    no data movement (compaction is explicit where layout matters)."""
+
+    def __init__(self, input: ExecutionPlan, predicate: L.Expr) -> None:
+        super().__init__()
+        self.input = input
+        self.predicate = predicate
+        self._fn: Callable[[DeviceBatch], DeviceBatch] | None = None
+
+    def schema(self) -> Schema:
+        return self.input.schema()
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def output_partitioning(self):
+        return self.input.output_partitioning()
+
+    def describe(self) -> str:
+        return f"FilterExec: {self.predicate.name()}"
+
+    def batch_fn(self) -> Callable[[DeviceBatch], DeviceBatch]:
+        if self._fn is None:
+            phys = compile_expr(self.predicate, self.input.schema())
+
+            def run(batch: DeviceBatch) -> DeviceBatch:
+                cv = phys.evaluate(batch)
+                keep = cv.values.astype(bool)
+                if cv.nulls is not None:
+                    keep = keep & ~cv.nulls  # NULL predicate = drop row
+                return batch.with_valid(batch.valid & keep)
+
+            self._fn = jax.jit(run)
+        return self._fn
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        fn = self.batch_fn()
+        for b in self.input.execute(partition, ctx):
+            with self.metrics.time("filter_time"):
+                out = fn(b)
+            self.metrics.add("input_batches")
+            yield out
+
+
+class ProjectionExec(ExecutionPlan):
+    """ref: ProjectionExecNode (ballista.proto:441-444)."""
+
+    def __init__(self, input: ExecutionPlan, exprs: list[L.Expr]) -> None:
+        super().__init__()
+        self.input = input
+        self.exprs = list(exprs)
+        ins = input.schema()
+        self._schema = Schema(
+            [Field(e.name(), e.data_type(ins), e.nullable(ins)) for e in self.exprs]
+        )
+        self._fn: Callable[[DeviceBatch], DeviceBatch] | None = None
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def output_partitioning(self):
+        return self.input.output_partitioning()
+
+    def describe(self) -> str:
+        return "ProjectionExec: " + ", ".join(e.name() for e in self.exprs)
+
+    def batch_fn(self) -> Callable[[DeviceBatch], DeviceBatch]:
+        if self._fn is None:
+            ins = self.input.schema()
+            phys = [compile_expr(e, ins) for e in self.exprs]
+            out_schema = self._schema
+
+            def run(batch: DeviceBatch) -> DeviceBatch:
+                cols, nulls, dicts = [], [], {}
+                for field, p in zip(out_schema, phys):
+                    cv = p.evaluate(batch)
+                    vals = cv.values
+                    want = field.dtype.to_np()
+                    if vals.dtype != want:
+                        vals = vals.astype(want)
+                    cols.append(vals)
+                    nulls.append(cv.nulls)
+                    if cv.dictionary is not None:
+                        dicts[field.name] = cv.dictionary
+                return batch.with_columns(out_schema, cols, nulls, dicts)
+
+            self._fn = jax.jit(run)
+        return self._fn
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        fn = self.batch_fn()
+        for b in self.input.execute(partition, ctx):
+            with self.metrics.time("project_time"):
+                out = fn(b)
+            yield out
+
+
+class CoalescePartitionsExec(ExecutionPlan):
+    """Merge all input partitions into one stream (ref: DataFusion
+    CoalescePartitionsExec — the stage-boundary operator the distributed
+    planner splits on, scheduler/src/planner.rs:104-132)."""
+
+    def __init__(self, input: ExecutionPlan) -> None:
+        super().__init__()
+        self.input = input
+
+    def schema(self) -> Schema:
+        return self.input.schema()
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def describe(self) -> str:
+        return "CoalescePartitionsExec"
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        assert partition == 0, "coalesce has a single output partition"
+        part = self.input.output_partitioning()
+        for p in range(part.n):
+            yield from self.input.execute(p, ctx)
+
+
+class RenameExec(ExecutionPlan):
+    """Schema rename (SubqueryAlias): same columns, requalified names."""
+
+    def __init__(self, input: ExecutionPlan, new_schema: Schema) -> None:
+        super().__init__()
+        self.input = input
+        self._schema = new_schema
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def output_partitioning(self):
+        return self.input.output_partitioning()
+
+    def describe(self) -> str:
+        return f"RenameExec: {self._schema.names}"
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        old = self.input.schema()
+        for b in self.input.execute(partition, ctx):
+            dicts = {}
+            for i, (of, nf) in enumerate(zip(old, self._schema)):
+                d = b.dictionaries.get(b.schema.fields[i].name)
+                if d is not None:
+                    dicts[nf.name] = d
+            yield DeviceBatch(
+                schema=self._schema,
+                columns=b.columns,
+                valid=b.valid,
+                nulls=b.nulls,
+                dictionaries=dicts,
+            )

@@ -1,0 +1,156 @@
+"""Physical planner: logical plan -> ExecutionPlan tree.
+
+The reference delegates this to DataFusion's physical planner (invoked at
+ballista/rust/scheduler/src/scheduler_server/grpc.rs:453-460); the node
+vocabulary mirrors PhysicalPlanNode (ballista.proto:275-623). Aggregates
+lower to partial/final pairs (the distributed repartition boundary), SEMI/
+ANTI join build sides are deduplicated on the join keys when there is no
+residual filter, and sorts always run over column keys (expressions are
+pre-projected by the SQL planner).
+"""
+
+from __future__ import annotations
+
+from ballista_tpu.errors import PlanError
+from ballista_tpu.exec.aggregate import HashAggregateExec
+from ballista_tpu.exec.base import ExecutionPlan
+from ballista_tpu.exec.joins import (
+    CrossJoinExec,
+    EmptyExec,
+    HashJoinExec,
+    UnionExec,
+)
+from ballista_tpu.exec.pipeline import (
+    CoalescePartitionsExec,
+    FilterExec,
+    ProjectionExec,
+    RenameExec,
+)
+from ballista_tpu.exec.scan import (
+    CsvScanExec,
+    MemoryScanExec,
+    ParquetScanExec,
+)
+from ballista_tpu.exec.sort import GlobalLimitExec, SortExec
+from ballista_tpu.expr import logical as L
+from ballista_tpu.plan import logical as P
+
+
+class TableProvider:
+    """Resolves a table name to a scan operator (the client keeps this
+    registry per-session, ref client/src/context.rs:258-308)."""
+
+    def scan(
+        self, table: str, projection: list[str] | None, partitions: int
+    ) -> ExecutionPlan:
+        raise NotImplementedError
+
+
+class PhysicalPlanner:
+    def __init__(self, provider: TableProvider, partitions: int = 2):
+        self.provider = provider
+        self.partitions = partitions
+
+    def plan(self, logical: P.LogicalPlan) -> ExecutionPlan:
+        return self._plan(logical)
+
+    def _plan(self, node: P.LogicalPlan) -> ExecutionPlan:
+        if isinstance(node, P.TableScan):
+            projection = list(node.projection) if node.projection else None
+            if node.source is not None and node.source[0] in ("csv", "parquet"):
+                # file tables are self-describing — no shared catalog needed
+                kind, path, has_header, delimiter = node.source
+                if kind == "csv":
+                    scan: ExecutionPlan = CsvScanExec(
+                        path, node.source_schema, has_header, delimiter,
+                        projection, self.partitions,
+                    )
+                else:
+                    scan = ParquetScanExec(
+                        path, node.source_schema, projection, self.partitions
+                    )
+                scan.table_name = node.table_name
+            else:
+                scan = self.provider.scan(
+                    node.table_name, projection, self.partitions
+                )
+                scan.table_name = node.table_name
+            for f in node.filters:
+                scan = FilterExec(scan, f)
+            return scan
+        if isinstance(node, P.Projection):
+            return ProjectionExec(self._plan(node.input), list(node.exprs))
+        if isinstance(node, P.Filter):
+            return FilterExec(self._plan(node.input), node.predicate)
+        if isinstance(node, P.Aggregate):
+            return self._plan_aggregate(node)
+        if isinstance(node, P.Distinct):
+            child = self._plan(node.input)
+            groups = [L.Column(f.name) for f in node.input.schema()]
+            partial = HashAggregateExec(child, groups, [], mode="partial")
+            return HashAggregateExec(
+                CoalescePartitionsExec(partial), groups, [],
+                mode="final", spec=partial.spec,
+                planned_input_schema=partial.planned_input_schema,
+            )
+        if isinstance(node, P.Sort):
+            return SortExec(self._plan(node.input), list(node.sort_exprs))
+        if isinstance(node, P.Limit):
+            child = self._plan(node.input)
+            if child.output_partitioning().n > 1:
+                child = CoalescePartitionsExec(child)
+            return GlobalLimitExec(child, node.skip, node.fetch)
+        if isinstance(node, P.Join):
+            return self._plan_join(node)
+        if isinstance(node, P.CrossJoin):
+            return CrossJoinExec(self._plan(node.left), self._plan(node.right))
+        if isinstance(node, P.Union):
+            return UnionExec([self._plan(c) for c in node.inputs])
+        if isinstance(node, P.SubqueryAlias):
+            return RenameExec(self._plan(node.input), node.schema())
+        if isinstance(node, P.EmptyRelation):
+            return EmptyExec(node.produce_one_row, node.out_schema)
+        raise PlanError(f"cannot lower {type(node).__name__} to physical plan")
+
+    def _plan_aggregate(self, node: P.Aggregate) -> ExecutionPlan:
+        child = self._plan(node.input)
+        partial = HashAggregateExec(
+            child, list(node.group_exprs), list(node.agg_exprs), mode="partial"
+        )
+        merged = CoalescePartitionsExec(partial)
+        return HashAggregateExec(
+            merged, list(node.group_exprs), list(node.agg_exprs),
+            mode="final", spec=partial.spec,
+            planned_input_schema=partial.planned_input_schema,
+        )
+
+    def _plan_join(self, node: P.Join) -> ExecutionPlan:
+        jt = node.join_type
+        if jt == P.JoinType.RIGHT:
+            # flip to LEFT; column order restored by a projection
+            flipped = P.Join(
+                node.right, node.left,
+                tuple((b, a) for a, b in node.on),
+                P.JoinType.LEFT, node.filter,
+            )
+            child = self._plan_join(flipped)
+            out = node.schema()
+            return ProjectionExec(
+                child, [L.Column(f.name) for f in out]
+            )
+        left = self._plan(node.left)
+        right = self._plan(node.right)
+        if jt in (P.JoinType.SEMI, P.JoinType.ANTI) and node.filter is None:
+            # The kernel needs a unique build side; existence semantics allow
+            # dedup on the join keys (ref HashJoinExec handles dup builds
+            # natively — our sort-probe kernel dedups instead).
+            keys = [b for _, b in node.on]
+            dpartial = HashAggregateExec(right, keys, [], mode="partial")
+            right = HashAggregateExec(
+                CoalescePartitionsExec(dpartial), keys, [],
+                mode="final", spec=dpartial.spec,
+                planned_input_schema=dpartial.planned_input_schema,
+            )
+            on = [(a, L.Column(k.name())) for (a, _), k in zip(node.on, keys)]
+            return HashJoinExec(left, right, on, jt, None)
+        return HashJoinExec(left, right, list(node.on), jt, node.filter)

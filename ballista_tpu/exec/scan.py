@@ -1,0 +1,192 @@
+"""Scan operators: host IO (pyarrow = Arrow C++) feeding DeviceBatches.
+
+The reference scans via DataFusion's ListingTable (CSV/Parquet/Avro
+providers, serialized in ballista.proto:60-92). Here scans decode on host
+with pyarrow and stage columns onto the device; string columns are
+dictionary-encoded table-wide at scan time so every batch of a scan shares
+dictionaries (SURVEY.md §7 "Strings/dictionaries on TPU").
+
+Pushed-down filters are evaluated per row group / per chunk on host Arrow
+data where cheap (parquet row-group pruning by min/max stats), then
+re-evaluated exactly on device — pruning is an optimization, never a
+correctness dependence.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.parquet as papq
+
+from ballista_tpu.columnar.arrow_interop import (
+    schema_to_arrow,
+    table_from_arrow,
+)
+from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.datatypes import Schema
+from ballista_tpu.exec.base import (
+    ExecutionPlan,
+    TaskContext,
+    UnknownPartitioning,
+)
+
+
+class MemoryScanExec(ExecutionPlan):
+    """Scan of an in-memory Arrow table, split into N partitions (the
+    DataFusion MemoryExec the reference's shuffle tests build on,
+    shuffle_writer.rs:489-520)."""
+
+    def __init__(
+        self,
+        table: pa.Table,
+        out_schema: Schema,
+        projection: list[str] | None = None,
+        partitions: int = 1,
+        batch_rows: int = 1 << 16,
+    ) -> None:
+        super().__init__()
+        self.table = table
+        self.projection = projection
+        self._schema = (
+            out_schema.select(projection) if projection else out_schema
+        )
+        self.partitions = max(1, partitions)
+        self.batch_rows = batch_rows
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def output_partitioning(self):
+        return UnknownPartitioning(self.partitions)
+
+    def describe(self) -> str:
+        cols = self.projection if self.projection else "*"
+        return f"MemoryScanExec: cols={cols}, partitions={self.partitions}"
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        t = self.table
+        if self.projection:
+            t = t.select(self.projection)
+        n = t.num_rows
+        per = -(-n // self.partitions)  # ceil
+        start = partition * per
+        stop = min(n, start + per)
+        if start >= stop:
+            yield DeviceBatch.empty(self._schema)
+            return
+        chunk = t.slice(start, stop - start)
+        for b in table_from_arrow(chunk, self.batch_rows):
+            self.metrics.add("output_rows", b.num_rows())
+            yield b
+
+
+class CsvScanExec(ExecutionPlan):
+    """CSV file scan (ref: CsvScanExecNode, ballista.proto:417-429)."""
+
+    def __init__(
+        self,
+        path: str,
+        table_schema: Schema,
+        has_header: bool = True,
+        delimiter: str = ",",
+        projection: list[str] | None = None,
+        partitions: int = 1,
+        batch_rows: int = 1 << 16,
+    ) -> None:
+        super().__init__()
+        self.path = path
+        self.table_schema = table_schema
+        self.has_header = has_header
+        self.delimiter = delimiter
+        self.projection = projection
+        self._schema = (
+            table_schema.select(projection) if projection else table_schema
+        )
+        self.partitions = max(1, partitions)
+        self.batch_rows = batch_rows
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def output_partitioning(self):
+        return UnknownPartitioning(self.partitions)
+
+    def describe(self) -> str:
+        return f"CsvScanExec: {self.path}, partitions={self.partitions}"
+
+    def _read(self) -> pa.Table:
+        arrow_schema = schema_to_arrow(self.table_schema)
+        convert = pacsv.ConvertOptions(
+            column_types={f.name: f.type for f in arrow_schema}
+        )
+        read = pacsv.ReadOptions(
+            column_names=None if self.has_header else arrow_schema.names,
+        )
+        parse = pacsv.ParseOptions(delimiter=self.delimiter)
+        return pacsv.read_csv(
+            self.path, read_options=read, parse_options=parse,
+            convert_options=convert,
+        )
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        with self.metrics.time("read_time"):
+            t = self._read()
+        mem = MemoryScanExec(
+            t, self.table_schema, self.projection, self.partitions,
+            self.batch_rows,
+        )
+        yield from mem.execute(partition, ctx)
+
+
+class ParquetScanExec(ExecutionPlan):
+    """Parquet scan with row-group pruning hooks (ref: ParquetScanExecNode,
+    ballista.proto:431-439; pruning flag config.rs BALLISTA_PARQUET_PRUNING).
+
+    Partitioning is by row-group ranges so partitions read disjoint byte
+    ranges of the file.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        table_schema: Schema,
+        projection: list[str] | None = None,
+        partitions: int = 1,
+        batch_rows: int = 1 << 16,
+    ) -> None:
+        super().__init__()
+        self.path = path
+        self.table_schema = table_schema
+        self.projection = projection
+        self._schema = (
+            table_schema.select(projection) if projection else table_schema
+        )
+        self.partitions = max(1, partitions)
+        self.batch_rows = batch_rows
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def output_partitioning(self):
+        return UnknownPartitioning(self.partitions)
+
+    def describe(self) -> str:
+        return f"ParquetScanExec: {self.path}, partitions={self.partitions}"
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        f = papq.ParquetFile(self.path)
+        ngroups = f.num_row_groups
+        per = -(-ngroups // self.partitions)
+        groups = list(range(partition * per, min(ngroups, (partition + 1) * per)))
+        cols = self.projection if self.projection else None
+        if not groups:
+            yield DeviceBatch.empty(self._schema)
+            return
+        with self.metrics.time("read_time"):
+            t = f.read_row_groups(groups, columns=cols)
+        # column order must match the projected schema
+        t = t.select([fld.name for fld in self._schema])
+        mem = MemoryScanExec(t, self._schema, None, 1, self.batch_rows)
+        yield from mem.execute(0, ctx)

@@ -1,0 +1,147 @@
+"""Sort and limit operators.
+
+ref: SortExecNode / LimitExecNode (ballista.proto:560-575). SortExec gathers
+its (single) input partition into one batch and runs the fused multi-key
+``lax.sort`` kernel; with a fetch bound it is a TopK (sort then truncate —
+the sort is already one fused XLA op, so a separate partial-TopK brings
+nothing on TPU until batches far exceed HBM).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.lru_cache(maxsize=None)
+def _fetch_program(cap: int, fetch: int):
+    def f(b):
+        keep = jnp.arange(cap) < fetch
+        return b.with_valid(b.valid & keep)
+
+    return jax.jit(f)
+
+from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.datatypes import Schema
+from ballista_tpu.errors import PlanError
+from ballista_tpu.exec.base import (
+    ExecutionPlan,
+    TaskContext,
+    UnknownPartitioning,
+)
+from ballista_tpu.expr import logical as L
+from ballista_tpu.ops.concat import concat_batches
+from ballista_tpu.ops.sort import SortKey, sort_batch
+from ballista_tpu.plan.logical import SortExpr
+
+
+class SortExec(ExecutionPlan):
+    def __init__(
+        self,
+        input: ExecutionPlan,
+        sort_exprs: list[SortExpr],
+        fetch: int | None = None,
+    ) -> None:
+        super().__init__()
+        self.input = input
+        self.sort_exprs = list(sort_exprs)
+        self.fetch = fetch
+        self._fn = None
+        ins = input.schema()
+        self._keys: list[SortKey] = []
+        for s in self.sort_exprs:
+            if not isinstance(s.expr, L.Column):
+                raise PlanError(
+                    "SortExec requires column sort keys (planner projects "
+                    "expressions first)"
+                )
+            self._keys.append(
+                SortKey(
+                    col=L.resolve_field_index(ins, s.expr.cname),
+                    ascending=s.ascending,
+                    nulls_first=s.nulls_first,
+                )
+            )
+
+    def schema(self) -> Schema:
+        return self.input.schema()
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def output_partitioning(self):
+        return UnknownPartitioning(1)
+
+    def describe(self) -> str:
+        ks = ", ".join(
+            f"{s.expr.name()} {'ASC' if s.ascending else 'DESC'}"
+            for s in self.sort_exprs
+        )
+        f = f", fetch={self.fetch}" if self.fetch is not None else ""
+        return f"SortExec: [{ks}]{f}"
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        assert partition == 0
+        batches = []
+        part = self.input.output_partitioning()
+        for p in range(part.n):
+            batches.extend(self.input.execute(p, ctx))
+        if not batches:
+            return
+        merged = concat_batches(batches)
+        # sort_batch host-composes cached argsort passes — no outer jit
+        # (that would re-inline the sorts into one slow-compiling program).
+        with self.metrics.time("sort_time"):
+            out = sort_batch(merged, self._keys)
+            if self.fetch is not None:
+                out = _fetch_program(out.capacity, self.fetch)(out)
+        yield out
+
+
+class GlobalLimitExec(ExecutionPlan):
+    """skip/fetch over the single merged input partition (ref:
+    GlobalLimitExecNode ballista.proto:567-571)."""
+
+    def __init__(self, input: ExecutionPlan, skip: int, fetch: int | None) -> None:
+        super().__init__()
+        self.input = input
+        self.skip = skip
+        self.fetch = fetch
+
+    def schema(self) -> Schema:
+        return self.input.schema()
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def output_partitioning(self):
+        return UnknownPartitioning(1)
+
+    def describe(self) -> str:
+        return f"GlobalLimitExec: skip={self.skip}, fetch={self.fetch}"
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        assert partition == 0
+        remaining_skip = self.skip
+        remaining = self.fetch
+        part = self.input.output_partitioning()
+        for p in range(part.n):
+            for b in self.input.execute(p, ctx):
+                if remaining is not None and remaining <= 0:
+                    return
+                # rank of live rows within the batch (order-preserving)
+                rank = jnp.cumsum(b.valid.astype(jnp.int32)) - 1
+                keep = b.valid & (rank >= remaining_skip)
+                if remaining is not None:
+                    keep = keep & (rank < remaining_skip + remaining)
+                out = b.with_valid(keep)
+                n_live = int(jnp.sum(b.valid.astype(jnp.int32)))
+                taken = max(0, n_live - remaining_skip)
+                if remaining is not None:
+                    taken = min(taken, remaining)
+                    remaining -= taken
+                remaining_skip = max(0, remaining_skip - n_live)
+                yield out

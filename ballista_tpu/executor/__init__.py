@@ -1,0 +1,6 @@
+"""Executor: task runner, shuffle data plane, Flight service, daemons.
+
+The reference's executor crate (ballista/rust/executor/src): poll loop /
+push server for task execution, ShuffleWriter materialization to Arrow IPC
+files, and an Arrow Flight `do_get` service for shuffle fetches.
+"""

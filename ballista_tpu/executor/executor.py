@@ -1,0 +1,201 @@
+"""Executor core + pull-mode poll loop.
+
+ref ballista/rust/executor/src/executor.rs:37-119 (Executor object owning
+work_dir + runtime) and execution_loop.rs:42-239 (poll loop: drain finished
+statuses, PollWork, decode plan, run shuffle write on a worker thread,
+report status on next poll).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+import traceback
+import uuid
+
+import grpc
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.errors import ExecutionError
+from ballista_tpu.exec.base import TaskContext
+from ballista_tpu.exec.planner import TableProvider
+from ballista_tpu.executor.shuffle import ShuffleWriterExec
+from ballista_tpu.proto import pb
+from ballista_tpu.scheduler.rpc import scheduler_stub
+from ballista_tpu.serde import BallistaCodec
+
+log = logging.getLogger(__name__)
+
+POLL_INTERVAL = 0.1  # ref execution_loop.rs:110-112 (100ms idle sleep)
+
+
+class Executor:
+    """ref executor.rs:37-119."""
+
+    def __init__(
+        self,
+        executor_id: str,
+        work_dir: str,
+        provider: TableProvider | None = None,
+        metrics_collector=None,
+    ):
+        self.executor_id = executor_id
+        self.work_dir = work_dir
+        self.provider = provider
+        self.codec = BallistaCodec(provider=provider)
+        from ballista_tpu.executor.metrics import LoggingMetricsCollector
+
+        self.metrics_collector = metrics_collector or LoggingMetricsCollector()
+
+    def execute_shuffle_write(
+        self, task: pb.TaskDefinition
+    ) -> list:
+        """Decode + rebind work_dir + run one input partition
+        (ref executor.rs:81-114)."""
+        node = pb.PhysicalPlanNode()
+        node.ParseFromString(task.plan)
+        plan = self.codec.physical_from_proto(node)
+        if not isinstance(plan, ShuffleWriterExec):
+            raise ExecutionError(
+                "task plan root must be ShuffleWriterExec "
+                f"(got {type(plan).__name__})"
+            )
+        props = {kv.key: kv.value for kv in task.props}
+        ctx = TaskContext(
+            config=BallistaConfig(props) if props else BallistaConfig(),
+            session_id=task.session_id,
+            job_id=task.task_id.job_id,
+            work_dir=self.work_dir,
+        )
+        out = plan.execute_shuffle_write(task.task_id.partition_id, ctx)
+        self.metrics_collector.record_stage(
+            task.task_id.job_id, task.task_id.stage_id,
+            task.task_id.partition_id, plan,
+        )
+        return out
+
+
+def as_task_status(
+    task_id: pb.PartitionId, executor_id: str, result, error: str | None
+) -> pb.TaskStatus:
+    """ref executor/src/lib.rs:39-68."""
+    st = pb.TaskStatus(task_id=task_id)
+    if error is not None:
+        st.failed.CopyFrom(pb.FailedTask(error=error[:4096]))
+        return st
+    st.completed.CopyFrom(
+        pb.CompletedTask(
+            executor_id=executor_id,
+            partitions=[
+                pb.ShuffleWritePartition(
+                    partition_id=m.partition_id,
+                    path=m.path,
+                    num_batches=m.num_batches,
+                    num_rows=m.num_rows,
+                    num_bytes=m.num_bytes,
+                )
+                for m in result
+            ],
+        )
+    )
+    return st
+
+
+class PollLoop:
+    """Pull-mode execution loop (ref execution_loop.rs:42-114)."""
+
+    def __init__(
+        self,
+        executor: Executor,
+        scheduler_addr: str,
+        flight_host: str,
+        flight_port: int,
+        task_slots: int = 4,
+    ):
+        self.executor = executor
+        self.scheduler_addr = scheduler_addr
+        self.flight_host = flight_host
+        self.flight_port = flight_port
+        self.task_slots = task_slots
+        self._available = threading.Semaphore(task_slots)
+        self._statuses: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, daemon=True, name="executor-poll-loop"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _metadata(self) -> pb.ExecutorMetadata:
+        return pb.ExecutorMetadata(
+            id=self.executor.executor_id,
+            host=self.flight_host,
+            port=self.flight_port,
+            specification=pb.ExecutorSpecification(task_slots=self.task_slots),
+        )
+
+    def run(self) -> None:
+        channel = grpc.insecure_channel(self.scheduler_addr)
+        stub = scheduler_stub(channel)
+        while not self._stop.is_set():
+            # drain completed statuses (ref :219-239)
+            statuses = []
+            while True:
+                try:
+                    statuses.append(self._statuses.get_nowait())
+                except queue.Empty:
+                    break
+            can_accept = self._available.acquire(blocking=False)
+            if can_accept:
+                self._available.release()
+            try:
+                result = stub.PollWork(
+                    pb.PollWorkParams(
+                        metadata=self._metadata(),
+                        can_accept_task=can_accept,
+                        task_status=statuses,
+                    )
+                )
+            except grpc.RpcError as e:
+                log.warning("poll_work failed: %s", e)
+                time.sleep(1.0)
+                continue
+            if result.HasField("task"):
+                self._run_task(result.task)
+            else:
+                time.sleep(POLL_INTERVAL)
+
+    def _run_task(self, task: pb.TaskDefinition) -> None:
+        """ref run_received_tasks :129-217 (panic-catching thread spawn)."""
+        self._available.acquire()
+
+        def work():
+            error = None
+            result = []
+            try:
+                result = self.executor.execute_shuffle_write(task)
+            except BaseException as e:  # noqa: BLE001 (catch_unwind parity)
+                error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+                log.error("task %s failed: %s", task.task_id, error)
+            finally:
+                self._available.release()
+            self._statuses.put(
+                as_task_status(
+                    task.task_id, self.executor.executor_id, result, error
+                )
+            )
+
+        threading.Thread(target=work, daemon=True, name="task-runner").start()
+
+
+def new_executor_id() -> str:
+    return uuid.uuid4().hex[:16]

@@ -1,0 +1,27 @@
+"""Executor metrics collection.
+
+ref ballista/rust/executor/src/metrics/mod.rs:26-58 — a collector trait and
+the default LoggingMetricsCollector that prints the annotated plan after
+every completed stage task.
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+class ExecutorMetricsCollector:
+    def record_stage(
+        self, job_id: str, stage_id: int, partition: int, plan
+    ) -> None:
+        raise NotImplementedError
+
+
+class LoggingMetricsCollector(ExecutorMetricsCollector):
+    def record_stage(self, job_id, stage_id, partition, plan) -> None:
+        log.info(
+            "=== [%s/%s/%s] Physical plan with metrics ===\n%s",
+            job_id, stage_id, partition, plan.display(with_metrics=True),
+        )

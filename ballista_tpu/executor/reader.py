@@ -1,0 +1,84 @@
+"""ShuffleReaderExec: fetch + merge shuffle partitions from executors.
+
+ref ballista/rust/core/src/execution_plans/shuffle_reader.rs:44-294. For its
+output partition p it fetches every mapped shuffle file (one per upstream
+task that produced rows for p): local paths read directly; remote ones
+fetched over Arrow Flight (`do_get` with a FetchPartition ticket — ref
+client.rs:75-130 <-> flight_service.rs:79-117).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+import pyarrow as pa
+import pyarrow.ipc as paipc
+
+from ballista_tpu.columnar.arrow_interop import table_from_arrow
+from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.datatypes import Schema
+from ballista_tpu.errors import ExecutionError
+from ballista_tpu.exec.base import (
+    ExecutionPlan,
+    TaskContext,
+    UnknownPartitioning,
+)
+from ballista_tpu.scheduler_types import PartitionLocation
+
+BATCH_ROWS = 1 << 16
+
+
+def fetch_partition_table(loc: PartitionLocation) -> pa.Table:
+    """One shuffle file -> Arrow table (local fast path, else Flight)."""
+    if os.path.exists(loc.path):
+        with paipc.open_file(loc.path) as r:
+            return r.read_all()
+    from ballista_tpu.client.flight import fetch_partition
+
+    return fetch_partition(loc)
+
+
+class ShuffleReaderExec(ExecutionPlan):
+    def __init__(
+        self,
+        partition_locations: list[list[PartitionLocation]],
+        schema: Schema,
+    ) -> None:
+        super().__init__()
+        self.partition_locations = [list(p) for p in partition_locations]
+        self._schema = schema
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def output_partitioning(self):
+        return UnknownPartitioning(max(1, len(self.partition_locations)))
+
+    def describe(self) -> str:
+        n = sum(len(p) for p in self.partition_locations)
+        return (
+            f"ShuffleReaderExec: {len(self.partition_locations)} partitions, "
+            f"{n} locations"
+        )
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        if partition >= len(self.partition_locations):
+            yield DeviceBatch.empty(self._schema)
+            return
+        locs = self.partition_locations[partition]
+        if not locs:
+            yield DeviceBatch.empty(self._schema)
+            return
+        any_rows = False
+        for loc in locs:
+            with self.metrics.time("fetch_time"):
+                t = fetch_partition_table(loc)
+            self.metrics.add("fetched_batches")
+            if t.num_rows == 0:
+                continue
+            any_rows = True
+            for b in table_from_arrow(t, BATCH_ROWS):
+                yield b
+        if not any_rows:
+            yield DeviceBatch.empty(self._schema)

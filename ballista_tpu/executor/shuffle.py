@@ -1,0 +1,183 @@
+"""ShuffleWriterExec: stage-root operator materializing shuffle output.
+
+ref ballista/rust/core/src/execution_plans/shuffle_writer.rs:65-431. For
+each input partition it executes the child fragment, hash-partitions rows
+on DEVICE (ops/partition.py — the reference's BatchPartitioner runs on CPU,
+:209-256), gathers each bucket to host, and appends to one Arrow IPC file
+per output partition:
+
+    <work_dir>/<job_id>/<stage_id>/<output_partition>/data-<input_partition>.arrow
+
+With no partition keys the stage writes a single output partition (the
+coalesce boundary, ref planner.rs:62-78). Returns per-file metadata
+(path + row/batch/byte stats) that flows back in CompletedTask statuses.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.ipc as paipc
+
+from ballista_tpu.columnar.arrow_interop import batch_to_arrow
+from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.datatypes import Schema
+from ballista_tpu.errors import ExecutionError
+from ballista_tpu.exec.base import (
+    ExecutionPlan,
+    HashPartitioning,
+    TaskContext,
+    UnknownPartitioning,
+)
+from ballista_tpu.expr import logical as L
+from ballista_tpu.ops.partition import partition_ids
+from ballista_tpu.scheduler_types import ShuffleWritePartitionMeta
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_partition_ids(key_idxs: tuple, num_partitions: int):
+    return jax.jit(
+        lambda b: partition_ids(b, list(key_idxs), num_partitions)
+    )
+
+
+class ShuffleWriterExec(ExecutionPlan):
+    def __init__(
+        self,
+        job_id: str,
+        stage_id: int,
+        input: ExecutionPlan,
+        partition_keys: list[L.Expr],
+        output_partitions: int,
+    ) -> None:
+        super().__init__()
+        self.job_id = job_id
+        self.stage_id = stage_id
+        self.input = input
+        self.partition_keys = list(partition_keys)
+        self.output_partitions = max(1, output_partitions)
+
+    def schema(self) -> Schema:
+        return self.input.schema()
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def output_partitioning(self):
+        if self.partition_keys:
+            return HashPartitioning(
+                tuple(self.partition_keys), self.output_partitions
+            )
+        return UnknownPartitioning(self.output_partitions)
+
+    def describe(self) -> str:
+        keys = [k.name() for k in self.partition_keys]
+        return (
+            f"ShuffleWriterExec: job={self.job_id}, stage={self.stage_id}, "
+            f"keys={keys}, out={self.output_partitions}"
+        )
+
+    # -- the task entry point (ref shuffle_writer.rs:142-292) ----------------
+    def execute_shuffle_write(
+        self, input_partition: int, ctx: TaskContext
+    ) -> list[ShuffleWritePartitionMeta]:
+        if not ctx.work_dir:
+            raise ExecutionError("shuffle write requires ctx.work_dir")
+        schema = self.input.schema()
+        key_idxs = tuple(
+            L.resolve_field_index(schema, k.cname)
+            if isinstance(k, L.Column)
+            else self._key_error(k)
+            for k in self.partition_keys
+        )
+        writers: dict[int, _IpcAppender] = {}
+
+        def appender(out_part: int) -> "_IpcAppender":
+            w = writers.get(out_part)
+            if w is None:
+                d = os.path.join(
+                    ctx.work_dir, self.job_id, str(self.stage_id),
+                    str(out_part),
+                )
+                os.makedirs(d, exist_ok=True)
+                path = os.path.join(d, f"data-{input_partition}.arrow")
+                w = _IpcAppender(path)
+                writers[out_part] = w
+            return w
+
+        with self.metrics.time("write_time"):
+            for batch in self.input.execute(input_partition, ctx):
+                if not self.partition_keys or self.output_partitions == 1:
+                    rb = batch_to_arrow(batch)
+                    if rb.num_rows:
+                        appender(0).write(rb)
+                    continue
+                with self.metrics.time("repart_time"):
+                    pids = np.asarray(
+                        _jit_partition_ids(key_idxs, self.output_partitions)(
+                            batch
+                        )
+                    )
+                rb = batch_to_arrow(batch)
+                live_pids = pids[np.asarray(batch.valid)]
+                for out_part in np.unique(live_pids):
+                    take = np.nonzero(live_pids == out_part)[0]
+                    part_rb = rb.take(pa.array(take))
+                    if part_rb.num_rows:
+                        appender(int(out_part)).write(part_rb)
+
+        out = []
+        for out_part, w in sorted(writers.items()):
+            num_rows, num_batches, num_bytes = w.close()
+            self.metrics.add("output_rows", num_rows)
+            out.append(
+                ShuffleWritePartitionMeta(
+                    partition_id=out_part,
+                    path=w.path,
+                    num_batches=num_batches,
+                    num_rows=num_rows,
+                    num_bytes=num_bytes,
+                )
+            )
+        return out
+
+    @staticmethod
+    def _key_error(k):
+        raise ExecutionError(
+            f"shuffle partition key {k.name()!r} must be a column"
+        )
+
+    # In-process fallback: stream the child through (used when a stage plan
+    # is executed without materialization, e.g. single-process mode).
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        yield from self.input.execute(partition, ctx)
+
+
+class _IpcAppender:
+    """One Arrow IPC file being appended batch-by-batch (the reference's
+    IPCWriter, shuffle_writer.rs:162-199)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._writer: paipc.RecordBatchFileWriter | None = None
+        self.num_rows = 0
+        self.num_batches = 0
+
+    def write(self, rb: pa.RecordBatch) -> None:
+        if self._writer is None:
+            self._writer = paipc.new_file(self.path, rb.schema)
+        self._writer.write_batch(rb)
+        self.num_rows += rb.num_rows
+        self.num_batches += 1
+
+    def close(self) -> tuple[int, int, int]:
+        if self._writer is not None:
+            self._writer.close()
+        num_bytes = os.path.getsize(self.path) if os.path.exists(self.path) else 0
+        return self.num_rows, self.num_batches, num_bytes

@@ -1,0 +1,62 @@
+"""Expression layer: logical AST + compilation to device evaluators.
+
+The reference gets expressions from DataFusion (logical ``Expr`` +
+``PhysicalExpr``), serialized at
+ballista/rust/core/src/serde/physical_plan/{to_proto,from_proto}.rs and
+ballista/rust/core/src/serde/logical_plan/. Here the logical AST is
+:mod:`ballista_tpu.expr.logical` and the device compiler is
+:mod:`ballista_tpu.expr.physical`.
+"""
+
+from ballista_tpu.expr.logical import (
+    AggFunc,
+    AggregateExpr,
+    Alias,
+    Between,
+    BinaryExpr,
+    Case,
+    Cast,
+    Column,
+    Expr,
+    InList,
+    IntervalLiteral,
+    IsNotNull,
+    IsNull,
+    Like,
+    Literal,
+    Negative,
+    Not,
+    Operator,
+    ScalarFunction,
+    Wildcard,
+    col,
+    lit,
+)
+from ballista_tpu.expr.physical import ColumnValue, compile_expr
+
+__all__ = [
+    "AggFunc",
+    "AggregateExpr",
+    "Alias",
+    "Between",
+    "BinaryExpr",
+    "Case",
+    "Cast",
+    "Column",
+    "ColumnValue",
+    "Expr",
+    "InList",
+    "IntervalLiteral",
+    "IsNotNull",
+    "IsNull",
+    "Like",
+    "Literal",
+    "Negative",
+    "Not",
+    "Operator",
+    "ScalarFunction",
+    "Wildcard",
+    "col",
+    "compile_expr",
+    "lit",
+]

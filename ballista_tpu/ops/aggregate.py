@@ -3,11 +3,12 @@
 Replaces DataFusion's HashAggregateExec (the reference serializes it at
 ballista/rust/core/src/serde/physical_plan/mod.rs HashAggregateExecNode arm;
 proto ballista.proto:275-623). TPU-native design: **sort-based grouping** —
-one fused ``lax.sort`` on the key columns, segment-boundary detection, then
-``segment_sum/min/max`` reductions. No hash table, no data-dependent control
-flow, fully static shapes with a configurable group-capacity bound
-(``ballista.tpu.agg_capacity``); overflow is detected on device and raised
-host-side.
+group keys sort via cached stable argsort passes (ops/perm.py; multi-operand
+``lax.sort`` is avoided for its pathological compile times), then one jitted
+finisher program does segment-boundary detection and segment scatter-reduces.
+No hash table, no data-dependent control flow, fully static shapes with a
+configurable group-capacity bound (``ballista.tpu.agg_capacity``); overflow
+is detected on device and raised host-side.
 
 Two-phase distributed aggregation mirrors the reference's partial/final
 split: partials produced per batch/partition are merged by re-running
@@ -17,12 +18,14 @@ group_aggregate with the merge ops (COUNT merges via SUM, etc.).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from enum import Enum
 
 import jax
 import jax.numpy as jnp
 
 from ballista_tpu.errors import ExecutionError
+from ballista_tpu.ops.perm import multi_key_perm
 
 
 class AggOp(Enum):
@@ -33,25 +36,41 @@ class AggOp(Enum):
 
     @property
     def merge_op(self) -> "AggOp":
-        """Op used to merge partial states (COUNT partials merge by SUM)."""
+        """Op used to merge partial states (COUNT merges by SUM)."""
         return AggOp.SUM if self == AggOp.COUNT else self
+
+
+def _sum_dtype(dtype):
+    """SQL SUM widens to the largest type of its class (int64 / float64);
+    BOOL sums count TRUEs."""
+    if dtype == jnp.bool_ or jnp.issubdtype(dtype, jnp.integer):
+        return jnp.int64
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.float64
+    return dtype
 
 
 def _max_ident(dtype) -> jnp.ndarray:
     if jnp.issubdtype(dtype, jnp.floating):
         return jnp.array(jnp.inf, dtype=dtype)
+    if dtype == jnp.bool_:
+        return jnp.array(True)
     return jnp.array(jnp.iinfo(dtype).max, dtype=dtype)
 
 
 def _min_ident(dtype) -> jnp.ndarray:
     if jnp.issubdtype(dtype, jnp.floating):
         return jnp.array(-jnp.inf, dtype=dtype)
+    if dtype == jnp.bool_:
+        return jnp.array(False)
     return jnp.array(jnp.iinfo(dtype).min, dtype=dtype)
 
 
+@jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class GroupAggResult:
-    """Device-side aggregation output, all arrays of length ``capacity``."""
+    """Device-side aggregation output, all arrays of length ``capacity``.
+    Registered as a pytree so aggregate passes can run under jit."""
 
     keys: list[jnp.ndarray]
     key_nulls: list[jnp.ndarray | None]
@@ -61,7 +80,19 @@ class GroupAggResult:
     n_groups: jnp.ndarray  # int32 scalar
     overflow: jnp.ndarray  # bool scalar: more groups than capacity
 
+    def tree_flatten(self):
+        return (
+            (self.keys, self.key_nulls, self.values, self.value_nulls,
+             self.valid, self.n_groups, self.overflow),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
     def check_overflow(self) -> None:
+        """Host-side check — call OUTSIDE jit (forces a device sync)."""
         if bool(self.overflow):
             raise ExecutionError(
                 f"aggregate exceeded group capacity "
@@ -69,44 +100,53 @@ class GroupAggResult:
             )
 
 
-def group_aggregate(
-    key_cols: list[jnp.ndarray],
-    key_nulls: list[jnp.ndarray | None],
-    valid: jnp.ndarray,
-    val_cols: list[jnp.ndarray],
-    val_nulls: list[jnp.ndarray | None],
-    ops: list[AggOp],
+@functools.lru_cache(maxsize=None)
+def _zeroed_program(kdtype: str, cap: int):
+    return jax.jit(lambda nm, kc: jnp.where(nm, jnp.zeros_like(kc), kc))
+
+
+@functools.lru_cache(maxsize=None)
+def _not_program(cap: int):
+    return jax.jit(lambda v: ~v)
+
+
+def _agg_finish(
+    perm,
+    valid,
+    key_cols: list,
+    key_nulls: list,
+    val_cols: list,
+    val_nulls: list,
+    ops: tuple,
     capacity: int,
 ) -> GroupAggResult:
-    """Aggregate ``val_cols[i]`` with ``ops[i]`` grouped by ``key_cols``.
-
-    All inputs share one row axis; ``valid`` masks live rows. Outputs have
-    static length ``capacity`` with a validity mask over actual groups.
-    """
+    """Jit-compiled finisher: everything after the sort passes. Gathers are
+    cheap to compile; there is no sort in here."""
     n = valid.shape[0]
-    iota = jnp.arange(n, dtype=jnp.int32)
-
-    # SQL GROUP BY: NULL is its own group. Null keys get a flag operand and a
-    # zeroed value so all-null rows compare equal.
-    operands: list[jnp.ndarray] = [~valid]  # valid rows first
-    for kc, kn in zip(key_cols, key_nulls):
-        if kn is not None:
-            operands.append(kn)
-            operands.append(jnp.where(kn, jnp.zeros_like(kc), kc))
-        else:
-            operands.append(kc)
-    num_keys = len(operands)
-    sorted_ops = jax.lax.sort(
-        operands + [iota], num_keys=num_keys, is_stable=True
-    )
-    perm = sorted_ops[-1]
     s_valid = valid[perm]
 
-    # Segment boundaries: first row, or any key operand differs from previous.
+    # Segment boundaries over the SORTED key operands. Null keys compare by
+    # (null flag, zeroed value); float keys: NaN==NaN is "same" (SQL groups
+    # NaNs together) and -0.0==+0.0 is "same".
     changed = jnp.zeros(n, dtype=bool).at[0].set(True)
-    for op_arr in sorted_ops[1:num_keys]:
+
+    def op_same(a, b):
+        same = a == b
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            same = same | (jnp.isnan(a) & jnp.isnan(b))
+        return same
+
+    for kc, kn in zip(key_cols, key_nulls):
+        if kn is not None:
+            s_kn = kn[perm]
+            changed = changed | jnp.concatenate(
+                [jnp.ones(1, dtype=bool), s_kn[1:] != s_kn[:-1]]
+            )
+            zc = jnp.where(kn, jnp.zeros_like(kc), kc)[perm]
+        else:
+            zc = kc[perm]
         changed = changed | jnp.concatenate(
-            [jnp.ones(1, dtype=bool), op_arr[1:] != op_arr[:-1]]
+            [jnp.ones(1, dtype=bool), ~op_same(zc[1:], zc[:-1])]
         )
     seg_id = jnp.cumsum(changed.astype(jnp.int32)) - 1
     n_groups = jnp.max(jnp.where(s_valid, seg_id, -1)) + 1
@@ -147,8 +187,9 @@ def group_aggregate(
             out_val_nulls.append(None)
             continue
         if op == AggOp.SUM:
-            contrib = jnp.where(live, s_vc, jnp.zeros_like(s_vc))
-            out = jnp.zeros(capacity, dtype=vc.dtype).at[rid].add(
+            acc_t = _sum_dtype(vc.dtype)
+            contrib = jnp.where(live, s_vc, jnp.zeros_like(s_vc)).astype(acc_t)
+            out = jnp.zeros(capacity, dtype=acc_t).at[rid].add(
                 contrib, mode="drop"
             )
         elif op == AggOp.MIN:
@@ -178,6 +219,45 @@ def group_aggregate(
     )
 
 
+_agg_finish_jit = jax.jit(_agg_finish, static_argnames=("ops", "capacity"))
+
+
+def group_aggregate(
+    key_cols: list[jnp.ndarray],
+    key_nulls: list[jnp.ndarray | None],
+    valid: jnp.ndarray,
+    val_cols: list[jnp.ndarray],
+    val_nulls: list[jnp.ndarray | None],
+    ops: list[AggOp],
+    capacity: int,
+) -> GroupAggResult:
+    """Aggregate ``val_cols[i]`` with ``ops[i]`` grouped by ``key_cols``.
+
+    All inputs share one row axis; ``valid`` masks live rows. Outputs have
+    static length ``capacity`` with a validity mask over actual groups.
+    Host-composes cached sort passes, then one jitted finisher.
+    """
+    cap = valid.shape[0]
+    # SQL GROUP BY: NULL is its own group. Null keys get a flag pass and a
+    # zeroed value so all-null rows compare equal.
+    passes: list[tuple[jnp.ndarray, bool]] = [
+        (_not_program(cap)(valid), False)  # valid rows first
+    ]
+    for kc, kn in zip(key_cols, key_nulls):
+        if kn is not None:
+            passes.append((kn, False))
+            passes.append(
+                (_zeroed_program(str(kc.dtype), cap)(kn, kc), False)
+            )
+        else:
+            passes.append((kc, False))
+    perm = multi_key_perm(passes)
+    return _agg_finish_jit(
+        perm, valid, list(key_cols), list(key_nulls), list(val_cols),
+        list(val_nulls), tuple(ops), capacity,
+    )
+
+
 def scalar_aggregate(
     valid: jnp.ndarray,
     val_cols: list[jnp.ndarray],
@@ -185,6 +265,10 @@ def scalar_aggregate(
     ops: list[AggOp],
 ) -> tuple[list[jnp.ndarray], list[jnp.ndarray | None]]:
     """Ungrouped aggregation -> one scalar per op (+ null flags)."""
+    return _scalar_agg_jit(valid, list(val_cols), list(val_nulls), tuple(ops))
+
+
+def _scalar_agg(valid, val_cols, val_nulls, ops):
     outs: list[jnp.ndarray] = []
     nulls: list[jnp.ndarray | None] = []
     for vc, vn, op in zip(val_cols, val_nulls, ops):
@@ -195,7 +279,13 @@ def scalar_aggregate(
             nulls.append(None)
             continue
         if op == AggOp.SUM:
-            outs.append(jnp.sum(jnp.where(live, vc, jnp.zeros_like(vc))))
+            outs.append(
+                jnp.sum(
+                    jnp.where(live, vc, jnp.zeros_like(vc)).astype(
+                        _sum_dtype(vc.dtype)
+                    )
+                )
+            )
         elif op == AggOp.MIN:
             outs.append(jnp.min(jnp.where(live, vc, _max_ident(vc.dtype))))
         elif op == AggOp.MAX:
@@ -204,3 +294,6 @@ def scalar_aggregate(
             raise ExecutionError(f"unknown agg op {op}")
         nulls.append(cnt == 0)
     return outs, nulls
+
+
+_scalar_agg_jit = jax.jit(_scalar_agg, static_argnames=("ops",))

@@ -2,27 +2,43 @@
 
 Filters in this engine only clear validity bits (no data movement). Before
 ops that are sensitive to row placement — shuffle writes, join builds,
-limits — an explicit compaction gathers live rows to the front via a stable
-argsort of the invalid flag (static-shaped; XLA-friendly; no host sync).
+limits — an explicit compaction gathers live rows to the front via one
+stable argsort pass on the invalid flag (cached program, see ops/perm.py).
 """
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 
 from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.ops.perm import stable_argsort, take
+
+
+@functools.lru_cache(maxsize=None)
+def _invalid_program(cap: int):
+    return jax.jit(lambda v: ~v)
+
+
+@functools.lru_cache(maxsize=None)
+def _front_valid_program(cap: int):
+    return jax.jit(
+        lambda v: jnp.arange(cap, dtype=jnp.int32)
+        < jnp.sum(v.astype(jnp.int32))
+    )
 
 
 def compact(batch: DeviceBatch) -> DeviceBatch:
-    order = jnp.argsort(~batch.valid, stable=True)
-    n = jnp.sum(batch.valid.astype(jnp.int32))
-    cols = tuple(c[order] for c in batch.columns)
-    nulls = tuple(None if m is None else m[order] for m in batch.nulls)
-    valid = jnp.arange(batch.capacity, dtype=jnp.int32) < n
+    order = stable_argsort(_invalid_program(batch.capacity)(batch.valid))
+    cols = tuple(take(c, order) for c in batch.columns)
+    nulls = tuple(None if m is None else take(m, order) for m in batch.nulls)
+    valid = _front_valid_program(batch.capacity)(batch.valid)
     return DeviceBatch(
         schema=batch.schema,
         columns=cols,
-        valid=valid,
         nulls=nulls,
+        valid=valid,
         dictionaries=dict(batch.dictionaries),
     )

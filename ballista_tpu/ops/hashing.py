@@ -35,8 +35,6 @@ def _to_u64(col: jnp.ndarray) -> jnp.ndarray:
     if jnp.issubdtype(col.dtype, jnp.floating):
         canon = col.astype(jnp.float32) + jnp.float32(0.0)
         return canon.view(jnp.uint32).astype(jnp.uint64)
-    if col.dtype == jnp.bool_:
-        return col.astype(jnp.uint64)
     return col.astype(jnp.uint64)
 
 

@@ -4,11 +4,15 @@ Replaces DataFusion's HashJoinExec (serialized by the reference at
 ballista/rust/core/src/serde/physical_plan/mod.rs:438-523, modes
 COLLECT_LEFT / PARTITIONED in ballista.proto:474-487). TPU-native design:
 
-- **build**: compact the build side, sort it by a packed 64-bit key
-  (``lax.sort``), keep columns in key order;
+- **build**: one ``lax.sort`` by (dead-flag, packed 64-bit key) — dead and
+  null-key rows sink to the end, live rows come out compacted AND key-sorted
+  in a single fused sort; all columns ride a permutation gather;
 - **probe**: ``searchsorted`` (vectorized binary search — log2(n) gathers,
-  no data-dependent loops), then verify the candidate by comparing the
-  *actual* key columns, so hash packing can never produce a wrong match.
+  no data-dependent loops) finds the start of the packed-key run, then a
+  fixed-width window scan verifies the *actual* key columns, so hash
+  packing can neither produce a wrong match nor miss a true match when
+  distinct keys collide in the packed hash (runs longer than the window are
+  detected at build and raised host-side).
 
 Supports INNER / LEFT (probe-preserving) / SEMI / ANTI with a unique build
 side — the PK-FK shape of every TPC-H join. Duplicate build keys are
@@ -18,6 +22,7 @@ detected on device and raised host-side (expansion joins are a later tier).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from enum import Enum
 
 import jax
@@ -26,8 +31,12 @@ import jax.numpy as jnp
 from ballista_tpu.columnar.batch import DeviceBatch
 from ballista_tpu.datatypes import Schema
 from ballista_tpu.errors import ExecutionError
-from ballista_tpu.ops.compact import compact
 from ballista_tpu.ops.hashing import hash_columns
+
+# Max packed-key collision run the probe window resolves. Distinct keys
+# colliding in the 64-bit packed hash is already rare (floats narrow to f32
+# bit patterns; multi-column keys hash); runs > 8 trip overflow at build.
+COLLISION_WINDOW = 8
 
 
 def _check_join_dictionaries(
@@ -55,27 +64,55 @@ class JoinSide(Enum):
     INNER = "inner"
     LEFT = "left"  # probe rows preserved, build columns nulled on miss
     SEMI = "semi"  # probe rows with a match (IN / EXISTS)
-    ANTI = "anti"  # probe rows without a match (NOT IN / NOT EXISTS)
+    ANTI = "anti"  # probe rows without a match — NOT EXISTS semantics:
+    #   null-key probe rows are KEPT (they match nothing). SQL NOT IN must
+    #   additionally drop null-key rows; the planner adds that filter.
+
+
+def _exact_pack(cols: list[jnp.ndarray]) -> bool:
+    """True when the packed key is injective (no collision scan needed)."""
+    return len(cols) == 1 and jnp.issubdtype(cols[0].dtype, jnp.integer)
 
 
 def _pack_key(cols: list[jnp.ndarray]) -> jnp.ndarray:
     """Rows -> int64 key. Single integer column is exact; multi-column uses a
     64-bit hash (candidates are verified against actual columns at probe)."""
-    if len(cols) == 1 and jnp.issubdtype(cols[0].dtype, jnp.integer):
+    if _exact_pack(cols):
         return cols[0].astype(jnp.int64)
     return hash_columns(cols).view(jnp.int64)
 
 
+@jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class BuildTable:
-    """Build side, compacted and sorted by packed key."""
+    """Build side, compacted and sorted by packed key (one fused sort).
+    Registered as a pytree so build/probe run under jit."""
 
-    batch: DeviceBatch  # columns in key-sorted order
-    keys: jnp.ndarray  # int64[cap], dead slots = INT64_MAX
+    batch: DeviceBatch  # columns in key-sorted order, live rows first
+    keys: jnp.ndarray  # int64[cap], dead slots forced to INT64_MAX
     key_cols: list[jnp.ndarray]  # actual key columns, sorted order
     key_idxs: list[int]  # key column indices into batch.schema
     n: jnp.ndarray  # int32 scalar: live build rows
+    exact: bool  # packed key is injective (window scan skipped)
     has_dups: jnp.ndarray  # bool scalar: duplicate keys among live rows
+    run_overflow: jnp.ndarray  # bool scalar: collision run > COLLISION_WINDOW
+
+    def tree_flatten(self):
+        leaves = (
+            self.batch, self.keys, self.key_cols, self.n,
+            self.has_dups, self.run_overflow,
+        )
+        return leaves, (tuple(self.key_idxs), self.exact)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        batch, keys, key_cols, n, has_dups, run_overflow = leaves
+        key_idxs, exact = aux
+        return cls(
+            batch=batch, keys=keys, key_cols=list(key_cols),
+            key_idxs=list(key_idxs), n=n, exact=exact,
+            has_dups=has_dups, run_overflow=run_overflow,
+        )
 
     def check_unique(self) -> None:
         if bool(self.has_dups):
@@ -83,43 +120,111 @@ class BuildTable:
                 "join build side has duplicate keys; only unique-build "
                 "(PK-FK) joins are supported on device in this version"
             )
+        if bool(self.run_overflow):
+            raise ExecutionError(
+                "join build side has a packed-hash collision run longer "
+                f"than {COLLISION_WINDOW}; use an integer join key or "
+                "reduce build size"
+            )
 
 
-def build_side(batch: DeviceBatch, key_idxs: list[int]) -> BuildTable:
-    # SQL equality: NULL keys never match anything — drop such build rows
-    # up front (they could otherwise match via the 0 fill value).
-    valid = batch.valid
-    for i in key_idxs:
-        nm = batch.nulls[i]
-        if nm is not None:
-            valid = valid & ~nm
-    c = compact(batch.with_valid(valid))
-    key_cols = [c.columns[i] for i in key_idxs]
-    packed = _pack_key(key_cols)
-    # Dead slots get INT64_MAX so they sort last and never match (verified
-    # against actual columns anyway).
-    packed = jnp.where(c.valid, packed, jnp.iinfo(jnp.int64).max)
-    iota = jnp.arange(c.capacity, dtype=jnp.int32)
-    keys_sorted, perm = jax.lax.sort([packed, iota], num_keys=1, is_stable=True)
-    cols = tuple(col[perm] for col in c.columns)
-    nulls = tuple(None if m is None else m[perm] for m in c.nulls)
-    sorted_batch = DeviceBatch(
-        schema=c.schema,
-        columns=cols,
-        valid=c.valid[perm],
-        nulls=nulls,
-        dictionaries=dict(c.dictionaries),
+@functools.lru_cache(maxsize=None)
+def _build_prep_program(key_idxs: tuple, cap: int, schema_key: tuple):
+    """(batch) -> (dead flag, packed key): the sort-pass operands."""
+
+    def f(batch: DeviceBatch):
+        valid = batch.valid
+        for i in key_idxs:
+            nm = batch.nulls[i]
+            if nm is not None:
+                valid = valid & ~nm
+        packed = _pack_key([batch.columns[i] for i in key_idxs])
+        return ~valid, packed
+
+    return jax.jit(f)
+
+
+def _build_finish(perm, dead, packed, batch: DeviceBatch, key_idxs: tuple,
+                  exact: bool) -> BuildTable:
+    """Jitted finisher after the sort passes (no sort in here)."""
+    cap = batch.capacity
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    n = jnp.sum((~dead).astype(jnp.int32))
+    valid_sorted = iota < n
+    # Dead tail forced to INT64_MAX keeps `keys` sorted (all live packed
+    # values are <= MAX) and inert to searchsorted.
+    keys_sorted = jnp.where(
+        valid_sorted, packed[perm], jnp.iinfo(jnp.int64).max
     )
-    n = jnp.sum(c.valid.astype(jnp.int32))
-    valid_pair = sorted_batch.valid[1:] & sorted_batch.valid[:-1]
-    dup = jnp.any(valid_pair & (keys_sorted[1:] == keys_sorted[:-1]))
+    cols = tuple(col[perm] for col in batch.columns)
+    nulls = tuple(None if m is None else m[perm] for m in batch.nulls)
+    sorted_batch = DeviceBatch(
+        schema=batch.schema,
+        columns=cols,
+        valid=valid_sorted,
+        nulls=nulls,
+        dictionaries=dict(batch.dictionaries),
+    )
+    sorted_key_cols = [cols[i] for i in key_idxs]
+
+    # Duplicate actual keys may be separated inside a packed-collision run,
+    # so compare each row against the next COLLISION_WINDOW-1 rows of its
+    # run (vector shifts, no gathers). With exact packing adjacent suffices.
+    scan = 1 if exact else COLLISION_WINDOW - 1
+    dup = jnp.zeros((), dtype=bool)
+    for j in range(1, scan + 1):
+        pair_live = valid_sorted[j:] & valid_sorted[:-j]
+        same_run = keys_sorted[j:] == keys_sorted[:-j]
+        eq = jnp.ones(cap - j, dtype=bool)
+        for kc in sorted_key_cols:
+            eq = eq & (kc[j:] == kc[:-j])
+        dup = dup | jnp.any(pair_live & same_run & eq)
+
+    if exact:
+        run_overflow = jnp.zeros((), dtype=bool)
+    else:
+        # Length of each equal-packed run among live rows; probe scans a
+        # fixed window, so longer runs must fail loudly.
+        changed = jnp.concatenate(
+            [jnp.ones(1, dtype=bool), keys_sorted[1:] != keys_sorted[:-1]]
+        )
+        seg = jnp.cumsum(changed.astype(jnp.int32)) - 1
+        seg = jnp.where(valid_sorted, seg, cap)
+        lengths = jnp.zeros(cap, dtype=jnp.int32).at[seg].add(1, mode="drop")
+        run_overflow = jnp.max(lengths) > COLLISION_WINDOW
+
     return BuildTable(
         batch=sorted_batch,
         keys=keys_sorted,
-        key_cols=[col[perm] for col in (c.columns[i] for i in key_idxs)],
+        key_cols=sorted_key_cols,
         key_idxs=list(key_idxs),
         n=n,
+        exact=exact,
         has_dups=dup,
+        run_overflow=run_overflow,
+    )
+
+
+_build_finish_jit = jax.jit(
+    _build_finish, static_argnames=("key_idxs", "exact")
+)
+
+
+def build_side(batch: DeviceBatch, key_idxs: list[int]) -> BuildTable:
+    """Host-composed: cached sort passes + one jitted finisher.
+    SQL equality: NULL keys never match anything — such rows are dead."""
+    from ballista_tpu.ops.perm import multi_key_perm
+
+    key_cols = [batch.columns[i] for i in key_idxs]
+    exact = _exact_pack(key_cols)
+    schema_key = tuple(f.dtype.value for f in batch.schema)
+    dead, packed = _build_prep_program(
+        tuple(key_idxs), batch.capacity, schema_key
+    )(batch)
+    # Dead rows last; live rows ordered by packed key.
+    perm = multi_key_perm([(dead, False), (packed, False)])
+    return _build_finish_jit(
+        perm, dead, packed, batch, tuple(key_idxs), exact
     )
 
 
@@ -135,18 +240,30 @@ def probe_side(
     probe_keys = [probe.columns[i] for i in probe_key_idxs]
     packed = _pack_key(probe_keys)
     idx = jnp.searchsorted(build.keys, packed)
-    cand = jnp.clip(idx, 0, build.keys.shape[0] - 1)
+    cap_b = build.keys.shape[0]
 
-    match = (idx < build.n) & probe.valid
-    for bk, pk in zip(build.key_cols, probe_keys):
-        # jnp promotion (x64 on) widens mixed int32/int64 correctly; never
-        # cast the probe down to the build dtype.
-        match = match & (bk[cand] == pk)
+    live = probe.valid
     # Null keys never match (SQL equality semantics).
     for pk_i in probe_key_idxs:
         nm = probe.nulls[pk_i]
         if nm is not None:
-            match = match & ~nm
+            live = live & ~nm
+
+    # Window scan over the packed-key run: actual-key equality implies equal
+    # packed keys, so every true match lies within the run starting at idx.
+    window = 1 if build.exact else COLLISION_WINDOW
+    match = jnp.zeros(probe.capacity, dtype=bool)
+    cand = jnp.clip(idx, 0, cap_b - 1)
+    for j in range(window):
+        cand_j = jnp.clip(idx + j, 0, cap_b - 1)
+        ok = (idx + j < build.n) & live
+        for bk, pk in zip(build.key_cols, probe_keys):
+            # jnp promotion (x64 on) widens mixed int32/int64 correctly;
+            # never cast the probe down to the build dtype.
+            ok = ok & (bk[cand_j] == pk)
+        cand = jnp.where(ok & ~match, cand_j, cand)
+        match = match | ok
+
     if join_type == JoinSide.SEMI:
         return probe.with_valid(match)
     if join_type == JoinSide.ANTI:

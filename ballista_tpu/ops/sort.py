@@ -1,11 +1,12 @@
 """Multi-key sort on device.
 
 Replaces DataFusion's SortExec (referenced by the plan serde at
-ballista/rust/core/src/serde/physical_plan/mod.rs sort arm). Uses
-``jax.lax.sort`` with multiple key operands — a single fused, static-shape
-lexicographic sort; all other columns ride along as payload via a permutation
-index. Invalid rows always sort last (leading ``~valid`` key), so a sorted
-batch is also compact.
+ballista/rust/core/src/serde/physical_plan/mod.rs sort arm). A multi-key
+sort runs as stable single-key argsort passes, least-significant key first
+(LSD radix over cached per-(dtype,capacity) programs — see ops/perm.py for
+why multi-operand ``lax.sort`` is avoided); all columns then ride one
+gather per column. Invalid rows always sort last (leading ``~valid`` pass),
+so a sorted batch is also compact.
 
 String columns sort correctly by dictionary code because dictionaries are
 order-preserving (see columnar.arrow_interop).
@@ -14,11 +15,13 @@ order-preserving (see columnar.arrow_interop).
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
 
 from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.ops.perm import multi_key_perm, take
 
 
 @dataclasses.dataclass(frozen=True)
@@ -30,36 +33,45 @@ class SortKey:
     nulls_first: bool = False
 
 
-def _direction(col: jnp.ndarray, ascending: bool) -> jnp.ndarray:
-    if ascending:
-        return col
-    if jnp.issubdtype(col.dtype, jnp.integer):
-        return ~col  # ~x = -x-1: total order reversal incl. INT_MIN
-    if col.dtype == jnp.bool_:
-        return ~col
-    return -col
+@functools.lru_cache(maxsize=None)
+def _invert_program(cap: int):
+    return jax.jit(lambda v: ~v)
 
 
-def sort_batch(batch: DeviceBatch, keys: list[SortKey]) -> DeviceBatch:
+@functools.lru_cache(maxsize=None)
+def _null_place_program(cap: int, nulls_first: bool):
+    # 0 sorts before 1: nulls_first -> nulls get 0.
+    return jax.jit(lambda nm: nm != nulls_first)
+
+
+def sort_perm(batch: DeviceBatch, keys: list[SortKey]) -> jnp.ndarray:
+    """The sorting permutation for ``keys`` (invalid rows last)."""
     cap = batch.capacity
-    operands: list[jnp.ndarray] = [~batch.valid]  # invalid rows last
+    passes: list[tuple[jnp.ndarray, bool]] = [
+        (_invert_program(cap)(batch.valid), False)  # invalid rows last
+    ]
     for k in keys:
-        col = batch.columns[k.col]
         nm = batch.nulls[k.col]
         if nm is not None:
-            # Null placement key: 0 sorts before 1.
-            operands.append(nm != k.nulls_first)
-        operands.append(_direction(col, k.ascending))
-    num_keys = len(operands)
-    operands.append(jnp.arange(cap, dtype=jnp.int32))  # payload: permutation
-    sorted_ops = jax.lax.sort(operands, num_keys=num_keys, is_stable=True)
-    perm = sorted_ops[-1]
-    cols = tuple(c[perm] for c in batch.columns)
-    nulls = tuple(None if m is None else m[perm] for m in batch.nulls)
+            passes.append(
+                (_null_place_program(cap, k.nulls_first)(nm), False)
+            )
+        passes.append((batch.columns[k.col], not k.ascending))
+    return multi_key_perm(passes)
+
+
+def gather_batch(batch: DeviceBatch, perm: jnp.ndarray) -> DeviceBatch:
+    """Reorder a whole batch by a permutation (one cached gather/column)."""
+    cols = tuple(take(c, perm) for c in batch.columns)
+    nulls = tuple(None if m is None else take(m, perm) for m in batch.nulls)
     return DeviceBatch(
         schema=batch.schema,
         columns=cols,
-        valid=batch.valid[perm],
+        valid=take(batch.valid, perm),
         nulls=nulls,
         dictionaries=dict(batch.dictionaries),
     )
+
+
+def sort_batch(batch: DeviceBatch, keys: list[SortKey]) -> DeviceBatch:
+    return gather_batch(batch, sort_perm(batch, keys))

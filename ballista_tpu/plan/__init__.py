@@ -1,0 +1,43 @@
+"""Plan IR: logical plan nodes, optimizer, and the DataFrame builder.
+
+The reference's logical plans come from DataFusion and are serialized at
+ballista/rust/core/src/serde/logical_plan/; this package is the rebuild's
+own logical-plan layer (the engine substrate SURVEY.md §1 says we must
+supply ourselves).
+"""
+
+from ballista_tpu.plan.logical import (
+    Aggregate,
+    CrossJoin,
+    Distinct,
+    EmptyRelation,
+    Filter,
+    Join,
+    JoinType,
+    Limit,
+    LogicalPlan,
+    Projection,
+    Sort,
+    SortExpr,
+    SubqueryAlias,
+    TableScan,
+    Union,
+)
+
+__all__ = [
+    "Aggregate",
+    "CrossJoin",
+    "Distinct",
+    "EmptyRelation",
+    "Filter",
+    "Join",
+    "JoinType",
+    "Limit",
+    "LogicalPlan",
+    "Projection",
+    "Sort",
+    "SortExpr",
+    "SubqueryAlias",
+    "TableScan",
+    "Union",
+]

@@ -1,0 +1,6 @@
+"""Generated protobuf bindings (protoc --python_out against
+proto/ballista_tpu.proto; regenerate with `make proto` / see README)."""
+
+from ballista_tpu.proto import ballista_tpu_pb2 as pb
+
+__all__ = ["pb"]

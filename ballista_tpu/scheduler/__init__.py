@@ -1,0 +1,6 @@
+"""Scheduler: control plane, stage DAG state machine, task dispatch.
+
+The reference's scheduler crate (ballista/rust/scheduler/src): gRPC
+service, DistributedPlanner-driven stage generation, StageManager state
+machine, executor registry, pull/push task dispatch, persistent state.
+"""

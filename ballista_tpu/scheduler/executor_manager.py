@@ -1,0 +1,85 @@
+"""Executor registry: heartbeats + slot accounting.
+
+ref ballista/rust/scheduler/src/state/executor_manager.rs:28-145.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ballista_tpu.scheduler_types import ExecutorData, ExecutorMetadata
+
+DEFAULT_EXECUTOR_TIMEOUT_SECONDS = 60.0  # ref :69-77
+
+
+class ExecutorManager:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._heartbeats: dict[str, float] = {}
+        self._metadata: dict[str, ExecutorMetadata] = {}
+        self._data: dict[str, ExecutorData] = {}
+
+    def save_executor_metadata(self, meta: ExecutorMetadata) -> None:
+        with self._lock:
+            self._metadata[meta.id] = meta
+
+    def get_executor_metadata(self, executor_id: str) -> ExecutorMetadata | None:
+        with self._lock:
+            return self._metadata.get(executor_id)
+
+    def all_executors(self) -> list[ExecutorMetadata]:
+        with self._lock:
+            return list(self._metadata.values())
+
+    def save_executor_heartbeat(self, executor_id: str) -> None:
+        with self._lock:
+            self._heartbeats[executor_id] = time.time()
+
+    def last_seen(self, executor_id: str) -> float | None:
+        with self._lock:
+            return self._heartbeats.get(executor_id)
+
+    def get_alive_executors(
+        self, timeout: float = DEFAULT_EXECUTOR_TIMEOUT_SECONDS
+    ) -> set[str]:
+        """ref :55-77 — alive = heartbeat within the window."""
+        now = time.time()
+        with self._lock:
+            return {
+                eid
+                for eid, ts in self._heartbeats.items()
+                if now - ts <= timeout
+            }
+
+    def save_executor_data(self, data: ExecutorData) -> None:
+        with self._lock:
+            self._data[data.executor_id] = data
+
+    def update_executor_data(self, executor_id: str, delta: int) -> None:
+        """Adjust available slots by +/- delta (ref :84-109)."""
+        with self._lock:
+            d = self._data.get(executor_id)
+            if d is None:
+                return
+            d.available_task_slots = max(
+                0, min(d.total_task_slots, d.available_task_slots + delta)
+            )
+
+    def get_executor_data(self, executor_id: str) -> ExecutorData | None:
+        with self._lock:
+            return self._data.get(executor_id)
+
+    def get_available_executors_data(self) -> list[ExecutorData]:
+        """Alive executors with free slots, most-free first (ref :121-135)."""
+        alive = self.get_alive_executors()
+        with self._lock:
+            out = [
+                ExecutorData(
+                    d.executor_id, d.total_task_slots, d.available_task_slots
+                )
+                for d in self._data.values()
+                if d.executor_id in alive and d.available_task_slots > 0
+            ]
+        out.sort(key=lambda d: -d.available_task_slots)
+        return out

@@ -1,0 +1,66 @@
+"""Hand-written gRPC plumbing for the two services.
+
+The build image has protoc but not the grpc_tools python plugin, so the
+method registry that generated *_pb2_grpc.py files would contain is written
+out here explicitly (same wire format, same service/method names as
+proto/ballista_tpu.proto services — ref ballista.proto:917-940).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from ballista_tpu.proto import pb
+
+SCHEDULER_SERVICE = "ballista_tpu.SchedulerGrpc"
+EXECUTOR_SERVICE = "ballista_tpu.ExecutorGrpc"
+
+SCHEDULER_METHODS = {
+    "PollWork": (pb.PollWorkParams, pb.PollWorkResult),
+    "RegisterExecutor": (pb.RegisterExecutorParams, pb.RegisterExecutorResult),
+    "HeartBeatFromExecutor": (pb.HeartBeatParams, pb.HeartBeatResult),
+    "UpdateTaskStatus": (pb.UpdateTaskStatusParams, pb.UpdateTaskStatusResult),
+    "GetFileMetadata": (pb.GetFileMetadataParams, pb.GetFileMetadataResult),
+    "ExecuteQuery": (pb.ExecuteQueryParams, pb.ExecuteQueryResult),
+    "GetJobStatus": (pb.GetJobStatusParams, pb.GetJobStatusResult),
+}
+
+EXECUTOR_METHODS = {
+    "LaunchTask": (pb.LaunchTaskParams, pb.LaunchTaskResult),
+    "StopExecutor": (pb.StopExecutorParams, pb.StopExecutorResult),
+}
+
+
+def add_service(server: grpc.Server, service: str, methods: dict, impl) -> None:
+    handlers = {}
+    for name, (req, resp) in methods.items():
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(impl, name),
+            request_deserializer=req.FromString,
+            response_serializer=lambda r: r.SerializeToString(),
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(service, handlers),)
+    )
+
+
+class _Stub:
+    def __init__(self, channel: grpc.Channel, service: str, methods: dict):
+        for name, (req, resp) in methods.items():
+            setattr(
+                self,
+                name,
+                channel.unary_unary(
+                    f"/{service}/{name}",
+                    request_serializer=lambda r: r.SerializeToString(),
+                    response_deserializer=resp.FromString,
+                ),
+            )
+
+
+def scheduler_stub(channel: grpc.Channel) -> _Stub:
+    return _Stub(channel, SCHEDULER_SERVICE, SCHEDULER_METHODS)
+
+
+def executor_stub(channel: grpc.Channel) -> _Stub:
+    return _Stub(channel, EXECUTOR_SERVICE, EXECUTOR_METHODS)

@@ -1,0 +1,543 @@
+"""SchedulerServer: query/stage orchestration + gRPC service.
+
+Combines the reference's SchedulerServer (scheduler_server/mod.rs:54-232),
+gRPC handlers (scheduler_server/grpc.rs:57-553), and QueryStageScheduler
+event loop (scheduler_server/query_stage_scheduler.rs:40-473):
+
+  ExecuteQuery -> plan (SQL -> logical -> optimized -> physical)
+              -> JobSubmitted event -> DistributedPlanner stage split
+              -> stage DAG submit (running if deps resolved, else pending)
+  PollWork    -> heartbeat + apply statuses + hand out <=1 task (pull mode)
+  StageFinished -> resolve dependent stages (patch shuffle locations)
+  JobFinished -> assemble CompletedJob partition locations
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import string
+import threading
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.distributed_plan import (
+    DistributedPlanner,
+    QueryStage,
+    find_unresolved_shuffles,
+    remove_unresolved_shuffles,
+)
+from ballista_tpu.errors import PlanError
+from ballista_tpu.event_loop import EventAction, EventLoop
+from ballista_tpu.exec.base import ExecutionPlan
+from ballista_tpu.exec.planner import PhysicalPlanner, TableProvider
+from ballista_tpu.plan.optimizer import optimize
+from ballista_tpu.proto import pb
+from ballista_tpu.scheduler.executor_manager import ExecutorManager
+from ballista_tpu.scheduler.stage_manager import (
+    JobFailed,
+    JobFinished,
+    StageFinished,
+    StageManager,
+    TaskState,
+)
+from ballista_tpu.scheduler_types import (
+    ExecutorData,
+    ExecutorMetadata,
+    ExecutorSpecification,
+    PartitionId,
+    PartitionLocation,
+    ShuffleWritePartitionMeta,
+)
+from ballista_tpu.serde import BallistaCodec, loc_to_proto
+from ballista_tpu.sql import ast
+from ballista_tpu.sql.parser import parse_sql
+from ballista_tpu.sql.planner import SqlPlanner
+
+log = logging.getLogger(__name__)
+
+
+def generate_job_id() -> str:
+    """7-char alnum ids (ref grpc.rs:546-553)."""
+    return "".join(random.choices(string.ascii_lowercase + string.digits, k=7))
+
+
+@dataclasses.dataclass
+class JobInfo:
+    job_id: str
+    session_id: str
+    status: str = "queued"  # queued | running | failed | completed
+    error: str = ""
+    stages: dict[int, QueryStage] = dataclasses.field(default_factory=dict)
+    # child stage id -> parent stage ids (parents consume the child)
+    dependencies: dict[int, set[int]] = dataclasses.field(default_factory=dict)
+    final_stage_id: int = 0
+    completed_locations: list[PartitionLocation] = dataclasses.field(
+        default_factory=list
+    )
+    # resolved (shuffle-patched) serialized plans, per stage
+    resolved_plan_bytes: dict[int, bytes] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSubmitted:
+    job_id: str
+    plan: ExecutionPlan
+
+
+class QueryStageScheduler(EventAction):
+    """The stage DAG state machine (ref query_stage_scheduler.rs:40-473)."""
+
+    def __init__(self, server: "SchedulerServer"):
+        self.server = server
+
+    def on_receive(self, event):
+        s = self.server
+        if isinstance(event, JobSubmitted):
+            s._generate_stages(event.job_id, event.plan)
+            return None
+        if isinstance(event, StageFinished):
+            s._on_stage_finished(event.job_id, event.stage_id)
+            return None
+        if isinstance(event, JobFinished):
+            s._on_job_finished(event.job_id)
+            return None
+        if isinstance(event, JobFailed):
+            s._on_job_failed(event.job_id, event.error)
+            return None
+        log.warning("unknown scheduler event %r", event)
+        return None
+
+
+class SchedulerServer:
+    """State + event loop. The gRPC servicer (:class:`SchedulerGrpcServicer`)
+    and the REST API both drive this object."""
+
+    def __init__(
+        self,
+        provider: TableProvider,
+        config: BallistaConfig | None = None,
+    ):
+        self.provider = provider
+        self.config = config or BallistaConfig()
+        self.codec = BallistaCodec(provider=provider)
+        self.stage_manager = StageManager()
+        self.executor_manager = ExecutorManager()
+        self.jobs: dict[str, JobInfo] = {}
+        self.sessions: dict[str, BallistaConfig] = {}
+        self._lock = threading.RLock()
+        self.event_loop = EventLoop("query-stage", QueryStageScheduler(self))
+        self.event_loop.start()
+        import time as _time
+
+        self.start_time = _time.time()
+
+    # -- session management (ref grpc.rs:350-374) ----------------------------
+    def get_or_create_session(
+        self, session_id: str, settings: dict[str, str]
+    ) -> str:
+        with self._lock:
+            if session_id and session_id in self.sessions:
+                if settings:
+                    self.sessions[session_id] = BallistaConfig(settings)
+                return session_id
+            new_id = "".join(
+                random.choices(string.ascii_lowercase + string.digits, k=16)
+            )
+            self.sessions[new_id] = (
+                BallistaConfig(settings) if settings else self.config
+            )
+            return new_id
+
+    # -- query submission ----------------------------------------------------
+    def submit_sql(self, sql: str, session_id: str) -> str:
+        stmt = parse_sql(sql)
+        if not isinstance(stmt, (ast.Select, ast.SetOp)):
+            raise PlanError("ExecuteQuery requires a SELECT statement")
+        logical = SqlPlanner(self.provider).plan(stmt)
+        return self.submit_logical(logical, session_id)
+
+    def submit_logical(self, logical, session_id: str) -> str:
+        cfg = self.sessions.get(session_id, self.config)
+        optimized = optimize(logical)
+        physical = PhysicalPlanner(
+            self.provider, cfg.default_shuffle_partitions()
+        ).plan(optimized)
+        return self.submit_physical(physical, session_id)
+
+    def submit_physical(self, physical: ExecutionPlan, session_id: str) -> str:
+        job_id = generate_job_id()
+        with self._lock:
+            self.jobs[job_id] = JobInfo(job_id=job_id, session_id=session_id)
+        self.event_loop.post(JobSubmitted(job_id, physical))
+        return job_id
+
+    # -- stage generation (ref query_stage_scheduler.rs:59-105) --------------
+    def _generate_stages(self, job_id: str, plan: ExecutionPlan) -> None:
+        try:
+            planner = DistributedPlanner()
+            stages = planner.plan_query_stages(job_id, plan)
+        except Exception as e:  # noqa: BLE001
+            self._on_job_failed(job_id, f"planning failed: {e}")
+            return
+        job = self.jobs[job_id]
+        deps: dict[int, set[int]] = {}
+        for stage in stages:
+            job.stages[stage.stage_id] = stage
+            for u in find_unresolved_shuffles(stage.plan):
+                deps.setdefault(u.stage_id, set()).add(stage.stage_id)
+        job.final_stage_id = stages[-1].stage_id
+        job.dependencies = deps
+        self.stage_manager.add_final_stage(job_id, job.final_stage_id)
+        self.stage_manager.add_stages_dependency(job_id, deps)
+        job.status = "running"
+        self._submit_stage(job_id, job.final_stage_id, set())
+
+    def _submit_stage(
+        self, job_id: str, stage_id: int, seen: set[int]
+    ) -> None:
+        """Recursive dependency walk (ref :124-177)."""
+        if stage_id in seen:
+            return
+        seen.add(stage_id)
+        if self.stage_manager.is_running_stage(
+            job_id, stage_id
+        ) or self.stage_manager.is_pending_stage(job_id, stage_id):
+            return
+        job = self.jobs[job_id]
+        stage = job.stages[stage_id]
+        unresolved = find_unresolved_shuffles(stage.plan)
+        unfinished = [
+            u
+            for u in unresolved
+            if not self.stage_manager.is_completed_stage(job_id, u.stage_id)
+        ]
+        n_tasks = stage.input_partition_count
+        if unfinished:
+            self.stage_manager.add_pending_stage(job_id, stage_id, n_tasks)
+            for u in unfinished:
+                self._submit_stage(job_id, u.stage_id, seen)
+        else:
+            self._resolve_stage(job_id, stage_id)
+            self.stage_manager.add_running_stage(job_id, stage_id, n_tasks)
+
+    def _resolve_stage(self, job_id: str, stage_id: int) -> None:
+        """Patch completed shuffle locations into the stage plan and
+        serialize it once (ref try_resolve_stage :181-309 +
+        task_scheduler.rs:146-156)."""
+        job = self.jobs[job_id]
+        stage = job.stages[stage_id]
+        unresolved = find_unresolved_shuffles(stage.plan)
+        if unresolved:
+            locations: dict[int, list[list[PartitionLocation]]] = {}
+            for u in unresolved:
+                locations[u.stage_id] = self._stage_output_locations(
+                    job_id, u.stage_id, u.output_partition_count
+                )
+            resolved = remove_unresolved_shuffles(stage.plan, locations)
+            stage.plan = resolved
+        job.resolved_plan_bytes[stage_id] = self.codec.physical_to_proto(
+            stage.plan
+        ).SerializeToString()
+
+    def _stage_output_locations(
+        self, job_id: str, stage_id: int, n_out: int
+    ) -> list[list[PartitionLocation]]:
+        locs: list[list[PartitionLocation]] = [[] for _ in range(n_out)]
+        for (task_idx, executor_id, metas) in (
+            self.stage_manager.completed_partitions(job_id, stage_id)
+        ):
+            meta_exec = self.executor_manager.get_executor_metadata(executor_id)
+            host = meta_exec.host if meta_exec else "localhost"
+            port = meta_exec.port if meta_exec else 0
+            for m in metas:
+                locs[m.partition_id].append(
+                    PartitionLocation(
+                        job_id=job_id,
+                        stage_id=stage_id,
+                        partition=m.partition_id,
+                        executor_id=executor_id,
+                        host=host,
+                        port=port,
+                        path=m.path,
+                    )
+                )
+        return locs
+
+    # -- event handlers ------------------------------------------------------
+    def _on_stage_finished(self, job_id: str, stage_id: int) -> None:
+        """Promote pending parents whose deps are all complete (ref
+        :107-122)."""
+        job = self.jobs.get(job_id)
+        if job is None:
+            return
+        for parent in self.stage_manager.parents_of(job_id, stage_id):
+            if not self.stage_manager.is_pending_stage(job_id, parent):
+                continue
+            unresolved = find_unresolved_shuffles(job.stages[parent].plan)
+            if all(
+                self.stage_manager.is_completed_stage(job_id, u.stage_id)
+                for u in unresolved
+            ):
+                self._resolve_stage(job_id, parent)
+                self.stage_manager.promote_pending_stage(job_id, parent)
+
+    def _on_job_finished(self, job_id: str) -> None:
+        """Assemble CompletedJob locations (ref :370-388, :416-473)."""
+        job = self.jobs.get(job_id)
+        if job is None:
+            return
+        final = job.stages[job.final_stage_id]
+        locs = self._stage_output_locations(
+            job_id, job.final_stage_id, final.output_partition_count
+        )
+        flat: list[PartitionLocation] = []
+        for part in locs:
+            flat.extend(part)
+        job.completed_locations = flat
+        job.status = "completed"
+        log.info("job %s completed (%d partitions)", job_id, len(flat))
+
+    def _on_job_failed(self, job_id: str, error: str) -> None:
+        job = self.jobs.get(job_id)
+        if job is None:
+            return
+        job.status = "failed"
+        job.error = error
+        log.error("job %s failed: %s", job_id, error)
+
+    # -- task handout (pull mode; ref grpc.rs:121-147) -----------------------
+    def next_task(self, executor_id: str) -> pb.TaskDefinition | None:
+        pick = self.stage_manager.fetch_schedulable_stage()
+        if pick is None:
+            return None
+        job_id, stage_id = pick
+        pending = self.stage_manager.fetch_pending_tasks(job_id, stage_id, 1)
+        if not pending:
+            return None
+        partition = pending[0]
+        task_id = PartitionId(job_id, stage_id, partition)
+        events = self.stage_manager.update_task_status(
+            task_id, TaskState.RUNNING, executor_id=executor_id
+        )
+        for e in events:
+            self.event_loop.post(e)
+        job = self.jobs[job_id]
+        plan_bytes = job.resolved_plan_bytes.get(stage_id)
+        if plan_bytes is None:
+            self._resolve_stage(job_id, stage_id)
+            plan_bytes = job.resolved_plan_bytes[stage_id]
+        cfg = self.sessions.get(job.session_id, self.config)
+        return pb.TaskDefinition(
+            task_id=pb.PartitionId(
+                job_id=job_id, stage_id=stage_id, partition_id=partition
+            ),
+            plan=plan_bytes,
+            props=[
+                pb.KeyValuePair(key=k, value=v)
+                for k, v in cfg.settings().items()
+            ],
+            session_id=job.session_id,
+        )
+
+    def apply_task_statuses(self, statuses: list[pb.TaskStatus]) -> None:
+        """ref scheduler_server/mod.rs update_task_status :171-191."""
+        for st in statuses:
+            tid = PartitionId(
+                st.task_id.job_id, st.task_id.stage_id, st.task_id.partition_id
+            )
+            kind = st.WhichOneof("status")
+            if kind == "completed":
+                metas = [
+                    ShuffleWritePartitionMeta(
+                        partition_id=int(p.partition_id),
+                        path=p.path,
+                        num_batches=int(p.num_batches),
+                        num_rows=int(p.num_rows),
+                        num_bytes=int(p.num_bytes),
+                    )
+                    for p in st.completed.partitions
+                ]
+                events = self.stage_manager.update_task_status(
+                    tid,
+                    TaskState.COMPLETED,
+                    executor_id=st.completed.executor_id,
+                    partitions=metas,
+                )
+            elif kind == "failed":
+                events = self.stage_manager.update_task_status(
+                    tid, TaskState.FAILED, error=st.failed.error
+                )
+            elif kind == "running":
+                events = self.stage_manager.update_task_status(
+                    tid, TaskState.RUNNING, executor_id=st.running.executor_id
+                )
+            else:
+                events = []
+            for e in events:
+                self.event_loop.post(e)
+
+    def job_status_proto(self, job_id: str) -> pb.JobStatus:
+        job = self.jobs.get(job_id)
+        if job is None:
+            return pb.JobStatus(failed=pb.FailedJob(error="unknown job"))
+        if job.status == "queued":
+            return pb.JobStatus(queued=pb.QueuedJob())
+        if job.status == "running":
+            return pb.JobStatus(running=pb.RunningJob())
+        if job.status == "failed":
+            return pb.JobStatus(failed=pb.FailedJob(error=job.error))
+        return pb.JobStatus(
+            completed=pb.CompletedJob(
+                partition_location=[
+                    loc_to_proto(l) for l in job.completed_locations
+                ]
+            )
+        )
+
+    def shutdown(self) -> None:
+        self.event_loop.stop()
+
+
+class SchedulerGrpcServicer:
+    """The gRPC surface (ref grpc.rs:57-553)."""
+
+    def __init__(self, server: SchedulerServer):
+        self.s = server
+
+    def PollWork(self, request: pb.PollWorkParams, context):
+        meta = request.metadata
+        em = ExecutorMetadata(
+            id=meta.id,
+            host=meta.host,
+            port=meta.port,
+            grpc_port=meta.grpc_port,
+            specification=ExecutorSpecification(
+                task_slots=meta.specification.task_slots or 4
+            ),
+        )
+        self.s.executor_manager.save_executor_metadata(em)
+        self.s.executor_manager.save_executor_heartbeat(meta.id)
+        if self.s.executor_manager.get_executor_data(meta.id) is None:
+            self.s.executor_manager.save_executor_data(
+                ExecutorData(
+                    meta.id,
+                    em.specification.task_slots,
+                    em.specification.task_slots,
+                )
+            )
+        self.s.apply_task_statuses(list(request.task_status))
+        result = pb.PollWorkResult()
+        if request.can_accept_task:
+            task = self.s.next_task(meta.id)
+            if task is not None:
+                result.task.CopyFrom(task)
+        return result
+
+    def RegisterExecutor(self, request, context):
+        meta = request.metadata
+        em = ExecutorMetadata(
+            id=meta.id,
+            host=meta.host,
+            port=meta.port,
+            grpc_port=meta.grpc_port,
+            specification=ExecutorSpecification(
+                task_slots=meta.specification.task_slots or 4
+            ),
+        )
+        self.s.executor_manager.save_executor_metadata(em)
+        self.s.executor_manager.save_executor_heartbeat(meta.id)
+        self.s.executor_manager.save_executor_data(
+            ExecutorData(
+                meta.id, em.specification.task_slots, em.specification.task_slots
+            )
+        )
+        return pb.RegisterExecutorResult(success=True)
+
+    def HeartBeatFromExecutor(self, request, context):
+        self.s.executor_manager.save_executor_heartbeat(request.executor_id)
+        return pb.HeartBeatResult(reregister=False)
+
+    def UpdateTaskStatus(self, request, context):
+        self.s.apply_task_statuses(list(request.task_status))
+        n_done = sum(
+            1
+            for st in request.task_status
+            if st.WhichOneof("status") in ("completed", "failed")
+        )
+        if n_done:
+            self.s.executor_manager.update_executor_data(
+                request.executor_id, n_done
+            )
+        return pb.UpdateTaskStatusResult(success=True)
+
+    def GetFileMetadata(self, request, context):
+        """Parquet-only schema inference (ref grpc.rs:279-326)."""
+        import pyarrow.parquet as papq
+
+        from ballista_tpu.columnar.arrow_interop import schema_from_arrow
+        from ballista_tpu.serde import schema_to_proto
+
+        if request.file_type not in ("parquet", ""):
+            context.abort(
+                __import__("grpc").StatusCode.INVALID_ARGUMENT,
+                f"unsupported file type {request.file_type!r}",
+            )
+        schema = schema_from_arrow(papq.read_schema(request.path))
+        return pb.GetFileMetadataResult(schema=schema_to_proto(schema))
+
+    def ExecuteQuery(self, request, context):
+        settings = {kv.key: kv.value for kv in request.settings}
+        session_id = self.s.get_or_create_session(request.session_id, settings)
+        kind = request.WhichOneof("query")
+        if kind is None:
+            # session-create-only call (ref context.rs remote() :83-135)
+            return pb.ExecuteQueryResult(job_id="", session_id=session_id)
+        try:
+            if kind == "sql":
+                job_id = self.s.submit_sql(request.sql, session_id)
+            else:
+                from ballista_tpu.serde import logical_from_proto
+
+                node = pb.LogicalPlanNode()
+                node.ParseFromString(request.logical_plan)
+                job_id = self.s.submit_logical(
+                    logical_from_proto(node), session_id
+                )
+        except Exception as e:  # noqa: BLE001
+            log.exception("ExecuteQuery failed")
+            job_id = generate_job_id()
+            self.s.jobs[job_id] = JobInfo(
+                job_id=job_id, session_id=session_id, status="failed",
+                error=str(e),
+            )
+        return pb.ExecuteQueryResult(job_id=job_id, session_id=session_id)
+
+    def GetJobStatus(self, request, context):
+        return pb.GetJobStatusResult(
+            status=self.s.job_status_proto(request.job_id)
+        )
+
+
+def start_scheduler_grpc(
+    server: SchedulerServer, host: str = "0.0.0.0", port: int = 0
+):
+    """Start the gRPC server; returns (grpc_server, bound_port)."""
+    import grpc as _grpc
+
+    from ballista_tpu.scheduler.rpc import (
+        SCHEDULER_METHODS,
+        SCHEDULER_SERVICE,
+        add_service,
+    )
+
+    gs = _grpc.server(
+        __import__("concurrent.futures", fromlist=["ThreadPoolExecutor"])
+        .ThreadPoolExecutor(max_workers=16)
+    )
+    add_service(gs, SCHEDULER_SERVICE, SCHEDULER_METHODS, SchedulerGrpcServicer(server))
+    bound = gs.add_insecure_port(f"{host}:{port}")
+    gs.start()
+    return gs, bound

@@ -1,0 +1,281 @@
+"""Stage/task bookkeeping state machine.
+
+ref ballista/rust/scheduler/src/state/stage_manager.rs:35-605. Tracks per
+stage a vector of task statuses with legal-transition validation
+(:536-586 — the reference's defensive mechanism against racy status
+updates), the child->parents stage dependency map (:140-155), pending /
+running / completed stage sets, and emits Stage/Job events on completion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import random
+import threading
+
+from ballista_tpu.errors import InternalError
+from ballista_tpu.scheduler_types import (
+    PartitionId,
+    ShuffleWritePartitionMeta,
+)
+
+
+class TaskState(enum.Enum):
+    PENDING = "pending"
+    RUNNING = "running"
+    FAILED = "failed"
+    COMPLETED = "completed"
+
+
+# Legal transitions (ref stage_manager.rs:536-586: e.g. Pending->Failed is
+# ignored; Completed->Pending re-opens a stage on status reset).
+_LEGAL = {
+    (TaskState.PENDING, TaskState.RUNNING),
+    (TaskState.RUNNING, TaskState.FAILED),
+    (TaskState.RUNNING, TaskState.COMPLETED),
+    (TaskState.RUNNING, TaskState.PENDING),  # reset (executor lost)
+    (TaskState.COMPLETED, TaskState.PENDING),  # re-open
+    (TaskState.FAILED, TaskState.PENDING),
+}
+
+
+@dataclasses.dataclass
+class TaskInfo:
+    state: TaskState = TaskState.PENDING
+    executor_id: str = ""
+    error: str = ""
+    partitions: list[ShuffleWritePartitionMeta] = dataclasses.field(
+        default_factory=list
+    )
+
+
+@dataclasses.dataclass
+class Stage:
+    job_id: str
+    stage_id: int
+    n_tasks: int  # = input partition count of the stage's ShuffleWriter
+    tasks: list[TaskInfo] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.tasks:
+            self.tasks = [TaskInfo() for _ in range(self.n_tasks)]
+
+    def counts(self) -> dict[TaskState, int]:
+        out = {s: 0 for s in TaskState}
+        for t in self.tasks:
+            out[t.state] += 1
+        return out
+
+    @property
+    def is_completed(self) -> bool:
+        return all(t.state == TaskState.COMPLETED for t in self.tasks)
+
+    @property
+    def has_failed(self) -> bool:
+        return any(t.state == TaskState.FAILED for t in self.tasks)
+
+
+class StageEvent:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class StageFinished(StageEvent):
+    job_id: str
+    stage_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class JobFinished(StageEvent):
+    job_id: str
+
+
+@dataclasses.dataclass(frozen=True)
+class JobFailed(StageEvent):
+    job_id: str
+    stage_id: int
+    error: str
+
+
+class StageManager:
+    """In-memory running/pending/completed stage maps (ref :326-356)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._stages: dict[tuple[str, int], Stage] = {}
+        self._running: set[tuple[str, int]] = set()
+        self._pending: set[tuple[str, int]] = set()
+        self._completed: set[tuple[str, int]] = set()
+        # child stage -> parent stages waiting on it (ref :140-155)
+        self._dependencies: dict[tuple[str, int], set[int]] = {}
+        self._final_stage: dict[str, int] = {}
+
+    # -- registration --------------------------------------------------------
+    def add_final_stage(self, job_id: str, stage_id: int) -> None:
+        with self._lock:
+            self._final_stage[job_id] = stage_id
+
+    def final_stage(self, job_id: str) -> int:
+        with self._lock:
+            return self._final_stage[job_id]
+
+    def add_stages_dependency(
+        self, job_id: str, deps: dict[int, set[int]]
+    ) -> None:
+        """deps: child_stage_id -> set of parent stage ids."""
+        with self._lock:
+            for child, parents in deps.items():
+                self._dependencies[(job_id, child)] = set(parents)
+
+    def parents_of(self, job_id: str, stage_id: int) -> set[int]:
+        with self._lock:
+            return set(self._dependencies.get((job_id, stage_id), set()))
+
+    def add_running_stage(self, job_id: str, stage_id: int, n_tasks: int) -> None:
+        with self._lock:
+            key = (job_id, stage_id)
+            self._stages[key] = Stage(job_id, stage_id, n_tasks)
+            self._running.add(key)
+            self._pending.discard(key)
+
+    def add_pending_stage(self, job_id: str, stage_id: int, n_tasks: int) -> None:
+        with self._lock:
+            key = (job_id, stage_id)
+            self._stages[key] = Stage(job_id, stage_id, n_tasks)
+            self._pending.add(key)
+
+    def is_running_stage(self, job_id: str, stage_id: int) -> bool:
+        with self._lock:
+            return (job_id, stage_id) in self._running
+
+    def is_pending_stage(self, job_id: str, stage_id: int) -> bool:
+        with self._lock:
+            return (job_id, stage_id) in self._pending
+
+    def is_completed_stage(self, job_id: str, stage_id: int) -> bool:
+        with self._lock:
+            return (job_id, stage_id) in self._completed
+
+    def get_stage(self, job_id: str, stage_id: int) -> Stage | None:
+        with self._lock:
+            return self._stages.get((job_id, stage_id))
+
+    # -- scheduling ----------------------------------------------------------
+    def fetch_pending_tasks(
+        self, job_id: str, stage_id: int, max_n: int
+    ) -> list[int]:
+        """Pending task (partition) ids of one stage, marking nothing."""
+        with self._lock:
+            stage = self._stages.get((job_id, stage_id))
+            if stage is None:
+                return []
+            out = [
+                i
+                for i, t in enumerate(stage.tasks)
+                if t.state == TaskState.PENDING
+            ]
+            return out[:max_n]
+
+    def fetch_schedulable_stage(self) -> tuple[str, int] | None:
+        """A random running stage with pending tasks (ref :300-324 — random
+        pick avoids head-of-line blocking across jobs)."""
+        with self._lock:
+            candidates = [
+                key
+                for key in self._running
+                if any(
+                    t.state == TaskState.PENDING
+                    for t in self._stages[key].tasks
+                )
+            ]
+            if not candidates:
+                return None
+            return random.choice(candidates)
+
+    # -- status updates ------------------------------------------------------
+    def update_task_status(
+        self,
+        task_id: PartitionId,
+        new_state: TaskState,
+        executor_id: str = "",
+        error: str = "",
+        partitions: list[ShuffleWritePartitionMeta] | None = None,
+    ) -> list[StageEvent]:
+        """Apply one task status; illegal transitions are ignored (the
+        reference rejects them rather than corrupting counts, :536-586).
+        Returns stage/job events triggered by this update."""
+        with self._lock:
+            key = (task_id.job_id, task_id.stage_id)
+            stage = self._stages.get(key)
+            if stage is None:
+                raise InternalError(f"unknown stage {key}")
+            if not (0 <= task_id.partition_id < stage.n_tasks):
+                raise InternalError(
+                    f"task partition {task_id.partition_id} out of range "
+                    f"for stage with {stage.n_tasks} tasks"
+                )
+            info = stage.tasks[task_id.partition_id]
+            if (info.state, new_state) not in _LEGAL:
+                return []
+            info.state = new_state
+            info.executor_id = executor_id or info.executor_id
+            info.error = error
+            if partitions is not None:
+                info.partitions = list(partitions)
+
+            events: list[StageEvent] = []
+            if new_state == TaskState.FAILED:
+                # one failed task fails the job (ref :221-227; no retry yet)
+                events.append(
+                    JobFailed(task_id.job_id, task_id.stage_id, error)
+                )
+            elif stage.is_completed and key in self._running:
+                self._running.discard(key)
+                self._completed.add(key)
+                if self._final_stage.get(task_id.job_id) == task_id.stage_id:
+                    events.append(JobFinished(task_id.job_id))
+                else:
+                    events.append(
+                        StageFinished(task_id.job_id, task_id.stage_id)
+                    )
+            return events
+
+    def promote_pending_stage(self, job_id: str, stage_id: int) -> None:
+        with self._lock:
+            key = (job_id, stage_id)
+            if key in self._pending:
+                self._pending.discard(key)
+                self._running.add(key)
+
+    def completed_partitions(
+        self, job_id: str, stage_id: int
+    ) -> list[tuple[int, str, list[ShuffleWritePartitionMeta]]]:
+        """[(task/partition index, executor_id, written files)] of a
+        completed stage (feeds PartitionLocation resolution)."""
+        with self._lock:
+            stage = self._stages.get((job_id, stage_id))
+            if stage is None:
+                return []
+            return [
+                (i, t.executor_id, list(t.partitions))
+                for i, t in enumerate(stage.tasks)
+                if t.state == TaskState.COMPLETED
+            ]
+
+    def has_running_tasks(self) -> bool:
+        with self._lock:
+            return any(
+                t.state == TaskState.RUNNING
+                for s in self._stages.values()
+                for t in s.tasks
+            )
+
+    def inflight_tasks(self) -> int:
+        with self._lock:
+            return sum(
+                1
+                for s in self._stages.values()
+                for t in s.tasks
+                if t.state in (TaskState.PENDING, TaskState.RUNNING)
+            )

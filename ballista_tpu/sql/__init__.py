@@ -1,0 +1,13 @@
+"""SQL frontend: tokenizer, parser, and SQL->logical-plan planner.
+
+The reference delegates SQL to DataFusion's sqlparser + SQL planner; this
+package is the rebuild's own frontend (engine substrate per SURVEY.md §1).
+Coverage target: the full TPC-H query set (benchmarks/queries/q1..q22.sql in
+the reference) plus the DDL the reference client intercepts
+(CREATE EXTERNAL TABLE, SHOW TABLES / SHOW COLUMNS — ref
+ballista/rust/client/src/context.rs:311-435).
+"""
+
+from ballista_tpu.sql.parser import parse_sql
+
+__all__ = ["parse_sql"]

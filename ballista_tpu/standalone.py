@@ -1,0 +1,84 @@
+"""Standalone (in-proc) cluster: scheduler + executor in one process.
+
+ref ballista/rust/scheduler/src/standalone.rs:34-59 and
+ballista/rust/executor/src/standalone.rs:38-93 — the testing backbone
+(SURVEY.md §3.5): real gRPC + real Flight over localhost random ports +
+temp work dirs, full cluster semantics without a cluster.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import tempfile
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.exec.planner import TableProvider
+from ballista_tpu.executor.executor import Executor, PollLoop, new_executor_id
+from ballista_tpu.executor.flight_service import start_flight_server
+from ballista_tpu.scheduler.server import SchedulerServer, start_scheduler_grpc
+
+
+@dataclasses.dataclass
+class StandaloneCluster:
+    scheduler: SchedulerServer
+    scheduler_grpc: object
+    scheduler_port: int
+    executor: Executor
+    poll_loop: PollLoop
+    flight_port: int
+    work_dir: str
+    _tmp: tempfile.TemporaryDirectory
+
+    @classmethod
+    def start(
+        cls,
+        config: BallistaConfig | None = None,
+        concurrent_tasks: int = 4,
+        provider: TableProvider | None = None,
+    ) -> "StandaloneCluster":
+        tmp = tempfile.TemporaryDirectory(prefix="ballista-standalone-")
+        work_dir = tmp.name
+
+        scheduler = SchedulerServer(provider=provider, config=config)
+        grpc_server, scheduler_port = start_scheduler_grpc(
+            scheduler, "127.0.0.1", 0
+        )
+
+        executor = Executor(
+            executor_id=new_executor_id(),
+            work_dir=work_dir,
+            provider=provider,
+        )
+        _svc, flight_port, _t = start_flight_server("127.0.0.1", 0, work_dir)
+        loop = PollLoop(
+            executor,
+            f"localhost:{scheduler_port}",
+            "localhost",
+            flight_port,
+            task_slots=concurrent_tasks,
+        )
+        loop.start()
+        return cls(
+            scheduler=scheduler,
+            scheduler_grpc=grpc_server,
+            scheduler_port=scheduler_port,
+            executor=executor,
+            poll_loop=loop,
+            flight_port=flight_port,
+            work_dir=work_dir,
+            _tmp=tmp,
+        )
+
+    def attach_provider(self, provider: TableProvider) -> None:
+        """Point scheduler planning + executor decode at a shared table
+        registry (the reference's client-side registration model)."""
+        self.scheduler.provider = provider
+        self.scheduler.codec.provider = provider
+        self.executor.provider = provider
+        self.executor.codec.provider = provider
+
+    def stop(self) -> None:
+        self.poll_loop.stop()
+        self.scheduler.shutdown()
+        self.scheduler_grpc.stop(grace=None)
+        self._tmp.cleanup()

@@ -1,0 +1,205 @@
+"""Single-process engine end-to-end: TPC-H queries vs pandas oracle.
+
+Mirrors the reference's in-proc integration tests
+(ballista/rust/client/src/context.rs:441-943: SELECT 1 smoke, aggregates
+against fixtures with golden results) with generated TPC-H data. SF is tiny
+(0.002) to keep device compiles fast; correctness is oracle-based, not
+golden-file-based, so any SF works.
+"""
+
+import datetime
+import pathlib
+
+import numpy as np
+import pytest
+
+from ballista_tpu.exec.context import TpuContext
+from ballista_tpu.tpch import gen_all
+
+QDIR = pathlib.Path(__file__).resolve().parent.parent / "benchmarks" / "queries"
+SCALE = 0.002
+
+
+@pytest.fixture(scope="module")
+def env():
+    ctx = TpuContext()
+    data = gen_all(scale=SCALE)
+    for name, t in data.items():
+        ctx.register_table(name, t)
+    frames = {k: v.to_pandas() for k, v in data.items()}
+    return ctx, frames
+
+
+def run(ctx, name):
+    return ctx.sql((QDIR / f"{name}.sql").read_text()).collect().to_pandas()
+
+
+def test_select_one(env):
+    ctx, _ = env
+    out = ctx.sql("select 1").collect().to_pandas()
+    assert out.iloc[0, 0] == 1
+
+
+def test_show_tables_and_columns(env):
+    ctx, _ = env
+    t = ctx.sql("show tables").collect().to_pandas()
+    assert "lineitem" in set(t.table_name)
+    c = ctx.sql("show columns from nation").collect().to_pandas()
+    assert list(c.column_name) == ["n_nationkey", "n_name", "n_regionkey", "n_comment"]
+
+
+def test_q6(env):
+    ctx, f = env
+    got = run(ctx, "q6").iloc[0, 0]
+    df = f["lineitem"]
+    m = (
+        (df.l_shipdate >= datetime.date(1994, 1, 1))
+        & (df.l_shipdate < datetime.date(1995, 1, 1))
+        & (df.l_discount >= 0.05)
+        & (df.l_discount <= 0.07)
+        & (df.l_quantity < 24)
+    )
+    want = float((df.l_extendedprice * df.l_discount)[m].sum())
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_q1(env):
+    ctx, f = env
+    res = run(ctx, "q1")
+    df = f["lineitem"]
+    cutoff = datetime.date(1998, 12, 1) - datetime.timedelta(days=90)
+    d = df[df.l_shipdate <= cutoff].copy()
+    d["disc_price"] = d.l_extendedprice * (1 - d.l_discount)
+    d["charge"] = d.disc_price * (1 + d.l_tax)
+    want = (
+        d.groupby(["l_returnflag", "l_linestatus"])
+        .agg(
+            sum_qty=("l_quantity", "sum"),
+            sum_base_price=("l_extendedprice", "sum"),
+            sum_disc_price=("disc_price", "sum"),
+            sum_charge=("charge", "sum"),
+            avg_qty=("l_quantity", "mean"),
+            avg_price=("l_extendedprice", "mean"),
+            avg_disc=("l_discount", "mean"),
+            count_order=("l_quantity", "count"),
+        )
+        .reset_index()
+        .sort_values(["l_returnflag", "l_linestatus"])
+        .reset_index(drop=True)
+    )
+    assert list(res.l_returnflag) == list(want.l_returnflag)
+    assert list(res.l_linestatus) == list(want.l_linestatus)
+    for col in [
+        "sum_qty", "sum_base_price", "sum_disc_price", "sum_charge",
+        "avg_qty", "avg_price", "avg_disc",
+    ]:
+        np.testing.assert_allclose(
+            res[col].to_numpy(), want[col].to_numpy(), rtol=1e-9, err_msg=col
+        )
+    np.testing.assert_array_equal(res["count_order"], want["count_order"])
+
+
+def test_q3(env):
+    ctx, f = env
+    res = run(ctx, "q3")
+    cust, orders, li = f["customer"], f["orders"], f["lineitem"]
+    j = cust[cust.c_mktsegment == "BUILDING"].merge(
+        orders, left_on="c_custkey", right_on="o_custkey"
+    )
+    j = j[j.o_orderdate < datetime.date(1995, 3, 15)]
+    j = j.merge(
+        li[li.l_shipdate > datetime.date(1995, 3, 15)],
+        left_on="o_orderkey",
+        right_on="l_orderkey",
+    )
+    j["rev"] = j.l_extendedprice * (1 - j.l_discount)
+    w = (
+        j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])
+        .rev.sum()
+        .reset_index()
+        .sort_values(["rev", "o_orderdate"], ascending=[False, True])
+        .head(10)
+        .reset_index(drop=True)
+    )
+    assert len(res) == len(w)
+    np.testing.assert_allclose(
+        res["revenue"].to_numpy(), w["rev"].to_numpy(), rtol=1e-9
+    )
+    np.testing.assert_array_equal(res["l_orderkey"], w["l_orderkey"])
+
+
+def test_q5(env):
+    ctx, f = env
+    res = run(ctx, "q5")
+    cu, o, li, s, n, r = (
+        f["customer"], f["orders"], f["lineitem"], f["supplier"],
+        f["nation"], f["region"],
+    )
+    j = (
+        cu.merge(o, left_on="c_custkey", right_on="o_custkey")
+        .merge(li, left_on="o_orderkey", right_on="l_orderkey")
+        .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+        .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+        .merge(r, left_on="n_regionkey", right_on="r_regionkey")
+    )
+    j = j[
+        (j.c_nationkey == j.s_nationkey)
+        & (j.r_name == "ASIA")
+        & (j.o_orderdate >= datetime.date(1994, 1, 1))
+        & (j.o_orderdate < datetime.date(1995, 1, 1))
+    ]
+    j["rev"] = j.l_extendedprice * (1 - j.l_discount)
+    w = (
+        j.groupby("n_name").rev.sum().reset_index()
+        .sort_values("rev", ascending=False).reset_index(drop=True)
+    )
+    assert len(res) == len(w)
+    if len(w):
+        assert list(res.n_name) == list(w.n_name)
+        np.testing.assert_allclose(
+            res["revenue"].to_numpy(), w["rev"].to_numpy(), rtol=1e-9
+        )
+
+
+def test_q12_case_aggregation(env):
+    ctx, f = env
+    res = run(ctx, "q12")
+    o, li = f["orders"], f["lineitem"]
+    j = o.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+    j = j[
+        j.l_shipmode.isin(["MAIL", "SHIP"])
+        & (j.l_commitdate < j.l_receiptdate)
+        & (j.l_shipdate < j.l_commitdate)
+        & (j.l_receiptdate >= datetime.date(1994, 1, 1))
+        & (j.l_receiptdate < datetime.date(1995, 1, 1))
+    ]
+    hi = j.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
+    w = (
+        j.assign(h=hi.astype(int), lo=(~hi).astype(int))
+        .groupby("l_shipmode")[["h", "lo"]]
+        .sum()
+        .reset_index()
+        .sort_values("l_shipmode")
+        .reset_index(drop=True)
+    )
+    assert len(res) == len(w)
+    if len(w):
+        np.testing.assert_array_equal(res["high_line_count"], w["h"])
+        np.testing.assert_array_equal(res["low_line_count"], w["lo"])
+
+
+def test_union_all(env):
+    ctx, _ = env
+    res = ctx.sql(
+        "select n_name from nation where n_regionkey = 0 "
+        "union all select r_name from region"
+    ).collect()
+    assert res.num_rows == 5 + 5  # 5 African nations + 5 regions
+
+
+def test_distinct(env):
+    ctx, f = env
+    res = ctx.sql(
+        "select distinct l_returnflag from lineitem"
+    ).collect().to_pandas()
+    assert set(res.l_returnflag) == set(f["lineitem"].l_returnflag.unique())

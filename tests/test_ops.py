@@ -291,3 +291,92 @@ def test_join_string_key_dictionary_mismatch_raises():
 def test_hash_negative_zero_canonical():
     h = hash_columns([jnp.array([0.0, -0.0], dtype=jnp.float64)])
     assert int(np.asarray(h)[0]) == int(np.asarray(h)[1])
+
+
+def test_group_sum_int32_widens():
+    """SUM over int32 must accumulate in int64 (SQL widening), not wrap."""
+    import jax.numpy as jnp
+
+    keys = jnp.zeros(4, dtype=jnp.int32)
+    vals = jnp.full(4, 2**30, dtype=jnp.int32)
+    valid = jnp.ones(4, dtype=bool)
+    res = group_aggregate([keys], [None], valid, [vals], [None], [AggOp.SUM], 8)
+    assert res.values[0].dtype == jnp.int64
+    assert int(res.values[0][0]) == 4 * 2**30
+
+
+def test_group_by_nan_is_one_group():
+    """SQL groups all NaN keys together (pandas/DataFusion behavior)."""
+    import jax.numpy as jnp
+
+    keys = jnp.asarray([float("nan"), float("nan"), 1.0, float("nan")])
+    vals = jnp.ones(4, dtype=jnp.int64)
+    valid = jnp.ones(4, dtype=bool)
+    res = group_aggregate([keys], [None], valid, [vals], [None], [AggOp.SUM], 8)
+    assert int(res.n_groups) == 2
+
+
+def test_build_side_float_collision_not_duplicate():
+    """Distinct f64 keys that collide in the packed (f32-narrowed) hash must
+    not be reported as duplicate build keys."""
+    import numpy as np
+
+    from ballista_tpu.columnar.batch import DeviceBatch
+    from ballista_tpu.datatypes import DataType, Field, Schema
+
+    schema = Schema([Field("k", DataType.FLOAT64), Field("v", DataType.INT64)])
+    b = DeviceBatch.from_host(
+        schema,
+        [np.asarray([1.0, 1.0 + 1e-12]), np.asarray([10, 20], dtype=np.int64)],
+        num_rows=2,
+    )
+    bt = build_side(b, [0])
+    bt.check_unique()  # must not raise
+
+
+def test_probe_finds_match_past_hash_collision():
+    """Distinct f64 build keys that collide in the f32-narrowed packed hash:
+    the window scan must still find the true match (and ANTI must drop it)."""
+    import numpy as np
+
+    from ballista_tpu.columnar.batch import DeviceBatch
+    from ballista_tpu.datatypes import DataType, Field, Schema
+
+    schema = Schema([Field("k", DataType.FLOAT64), Field("v", DataType.INT64)])
+    b = DeviceBatch.from_host(
+        schema,
+        [np.asarray([1.0, 1.0 + 1e-12]), np.asarray([10, 20], dtype=np.int64)],
+        num_rows=2,
+    )
+    bt = build_side(b, [0])
+    bt.check_unique()
+    pschema = Schema([Field("pk", DataType.FLOAT64)])
+    p = DeviceBatch.from_host(
+        pschema, [np.asarray([1.0 + 1e-12, 1.0, 2.0])], num_rows=3
+    )
+    out = probe_side(bt, p, [0], JoinSide.INNER)
+    live = np.asarray(out.valid)
+    vcol = np.asarray(out.column("v"))[live]
+    kcol = np.asarray(out.column("pk"))[live]
+    assert sorted(vcol.tolist()) == [10, 20]
+    assert set(kcol.tolist()) == {1.0, 1.0 + 1e-12}
+    anti = probe_side(bt, p, [0], JoinSide.ANTI)
+    alive = np.asarray(anti.valid)
+    akeys = np.asarray(anti.column("pk"))[alive]
+    assert akeys.tolist() == [2.0]
+
+
+def test_bool_min_max_sum():
+    import jax.numpy as jnp
+
+    keys = jnp.asarray([0, 0, 1, 1], dtype=jnp.int32)
+    vals = jnp.asarray([True, False, True, True])
+    valid = jnp.ones(4, dtype=bool)
+    res = group_aggregate(
+        [keys], [None], valid,
+        [vals, vals, vals], [None, None, None],
+        [AggOp.MIN, AggOp.MAX, AggOp.SUM], 8,
+    )
+    assert bool(res.values[0][0]) is False and bool(res.values[0][1]) is True
+    assert bool(res.values[1][0]) is True and bool(res.values[1][1]) is True
+    assert int(res.values[2][0]) == 1 and int(res.values[2][1]) == 2

@@ -1,0 +1,283 @@
+"""SQL parser tests (no device work — fast host-only)."""
+
+import datetime
+
+import pytest
+
+from ballista_tpu.datatypes import DataType
+from ballista_tpu.errors import SqlError
+from ballista_tpu.expr import logical as L
+from ballista_tpu.sql import ast
+from ballista_tpu.sql.parser import parse_sql
+
+Q1 = """
+select
+    l_returnflag,
+    l_linestatus,
+    sum(l_quantity) as sum_qty,
+    sum(l_extendedprice) as sum_base_price,
+    sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+    sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+    avg(l_quantity) as avg_qty,
+    avg(l_extendedprice) as avg_price,
+    avg(l_discount) as avg_disc,
+    count(*) as count_order
+from
+    lineitem
+where
+    l_shipdate <= date '1998-12-01' - interval '90' day
+group by
+    l_returnflag,
+    l_linestatus
+order by
+    l_returnflag,
+    l_linestatus;
+"""
+
+Q3 = """
+select
+    l_orderkey,
+    sum(l_extendedprice * (1 - l_discount)) as revenue,
+    o_orderdate,
+    o_shippriority
+from
+    customer,
+    orders,
+    lineitem
+where
+    c_mktsegment = 'BUILDING'
+    and c_custkey = o_custkey
+    and l_orderkey = o_orderkey
+    and o_orderdate < date '1995-03-15'
+    and l_shipdate > date '1995-03-15'
+group by
+    l_orderkey,
+    o_orderdate,
+    o_shippriority
+order by
+    revenue desc,
+    o_orderdate
+limit 10;
+"""
+
+Q18_FRAGMENT = """
+select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+       sum(l_quantity)
+from customer, orders, lineitem
+where o_orderkey in (
+        select l_orderkey
+        from lineitem
+        group by l_orderkey
+        having sum(l_quantity) > 300
+    )
+    and c_custkey = o_custkey
+    and o_orderkey = l_orderkey
+group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+order by o_totalprice desc, o_orderdate
+limit 100;
+"""
+
+Q21_FRAGMENT = """
+select s_name, count(*) as numwait
+from supplier, lineitem l1, orders, nation
+where s_suppkey = l1.l_suppkey
+    and o_orderkey = l1.l_orderkey
+    and o_orderstatus = 'F'
+    and exists (
+        select * from lineitem l2
+        where l2.l_orderkey = l1.l_orderkey
+            and l2.l_suppkey <> l1.l_suppkey
+    )
+    and not exists (
+        select * from lineitem l3
+        where l3.l_orderkey = l1.l_orderkey
+            and l3.l_receiptdate > l3.l_commitdate
+    )
+group by s_name
+order by numwait desc, s_name
+limit 100;
+"""
+
+
+def test_parse_q1():
+    s = parse_sql(Q1)
+    assert isinstance(s, ast.Select)
+    assert len(s.projections) == 10
+    assert isinstance(s.from_, ast.Relation) and s.from_.name == "lineitem"
+    # where: l_shipdate <= date - interval
+    w = s.where
+    assert isinstance(w, L.BinaryExpr) and w.op == L.Operator.LTEQ
+    assert isinstance(w.right, L.BinaryExpr) and w.op is not None
+    assert len(s.group_by) == 2
+    assert len(s.order_by) == 2
+    # alias capture
+    a = s.projections[2]
+    assert isinstance(a, L.Alias) and a.aname == "sum_qty"
+    aggs = L.find_aggregates(a)
+    assert aggs and aggs[0].func == L.AggFunc.SUM
+
+
+def test_parse_q3_comma_joins_and_limit():
+    s = parse_sql(Q3)
+    assert isinstance(s, ast.Select)
+    j = s.from_
+    assert isinstance(j, ast.JoinClause) and j.kind == "cross"
+    assert isinstance(j.left, ast.JoinClause)
+    assert s.limit == 10
+    assert s.order_by[0].ascending is False
+    assert s.order_by[1].ascending is True
+
+
+def test_parse_in_subquery_with_having():
+    s = parse_sql(Q18_FRAGMENT)
+    w = s.where
+    # top-level AND chain contains an InSubquery
+    found = []
+
+    def walk(e):
+        if isinstance(e, ast.InSubquery):
+            found.append(e)
+        for c in e.children():
+            walk(c)
+        if isinstance(e, ast.InSubquery):
+            pass
+
+    walk(w)
+    assert len(found) == 1
+    sub = found[0].query
+    assert sub.having is not None
+
+
+def test_parse_exists_and_not_exists():
+    s = parse_sql(Q21_FRAGMENT)
+    texts = []
+
+    def walk(e):
+        if isinstance(e, ast.Exists):
+            texts.append(e.negated)
+        if isinstance(e, L.Not):
+            inner = e.expr
+            if isinstance(inner, ast.Exists):
+                texts.append("not-exists")
+        for c in e.children():
+            walk(c)
+
+    walk(s.where)
+    assert False in texts  # plain EXISTS
+    assert "not-exists" in texts or True in texts
+
+
+def test_parse_case_when():
+    s = parse_sql(
+        "select sum(case when o_orderpriority = '1-URGENT' "
+        "or o_orderpriority = '2-HIGH' then 1 else 0 end) as high_line_count "
+        "from orders"
+    )
+    agg = L.find_aggregates(s.projections[0])[0]
+    assert isinstance(agg.arg, L.Case)
+    assert agg.arg.otherwise is not None
+
+
+def test_parse_interval_forms():
+    s = parse_sql("select * from t where d < date '1995-01-01' + interval '3' month")
+    w = s.where
+    assert isinstance(w.right, L.BinaryExpr)
+    iv = w.right.right
+    assert isinstance(iv, L.IntervalLiteral) and iv.months == 3
+
+    s2 = parse_sql("select * from t where d < date '1995-01-01' + interval '1' year")
+    iv2 = s2.where.right.right
+    assert iv2.months == 12
+
+
+def test_parse_date_literal():
+    s = parse_sql("select * from t where d >= date '1994-01-01'")
+    litr = s.where.right
+    assert isinstance(litr, L.Literal) and litr.dtype == DataType.DATE32
+    assert litr.value == (datetime.date(1994, 1, 1) - datetime.date(1970, 1, 1)).days
+
+
+def test_parse_substring_from_for():
+    s = parse_sql("select substring(c_phone from 1 for 2) cntrycode from customer")
+    p = s.projections[0]
+    assert isinstance(p, L.Alias) and p.aname == "cntrycode"
+    f = p.expr
+    assert isinstance(f, L.ScalarFunction) and f.fname == "substr"
+    assert len(f.args) == 3
+
+
+def test_parse_create_external_table():
+    s = parse_sql(
+        "CREATE EXTERNAL TABLE lineitem (l_orderkey BIGINT, l_quantity DOUBLE, "
+        "l_shipdate DATE, l_comment VARCHAR(44)) "
+        "STORED AS CSV WITH HEADER ROW LOCATION '/data/lineitem.csv'"
+    )
+    assert isinstance(s, ast.CreateExternalTable)
+    assert s.name == "lineitem"
+    assert s.stored_as == "csv"
+    assert s.has_header
+    assert s.location == "/data/lineitem.csv"
+    assert s.columns[2].dtype == DataType.DATE32
+
+
+def test_parse_show_and_explain():
+    assert isinstance(parse_sql("SHOW TABLES"), ast.ShowTables)
+    sc = parse_sql("SHOW COLUMNS FROM lineitem")
+    assert isinstance(sc, ast.ShowColumns) and sc.table == "lineitem"
+    ex = parse_sql("EXPLAIN SELECT 1")
+    assert isinstance(ex, ast.Explain)
+
+
+def test_parse_union_all():
+    s = parse_sql(
+        "select a from t1 union all select b from t2 order by a limit 5"
+    )
+    assert isinstance(s, ast.SetOp) and s.all
+    assert s.limit == 5 and len(s.order_by) == 1
+
+
+def test_parse_scalar_subquery():
+    s = parse_sql(
+        "select * from part where p_size = (select max(p_size) from part)"
+    )
+    r = s.where.right
+    assert isinstance(r, ast.ScalarSubquery)
+
+
+def test_parse_qualified_columns_and_aliases():
+    s = parse_sql(
+        "select n1.n_name as supp_nation from nation n1, nation n2 "
+        "where n1.n_nationkey = n2.n_nationkey"
+    )
+    p = s.projections[0]
+    assert isinstance(p.expr, L.Column) and p.expr.cname == "n1.n_name"
+    jc = s.from_
+    assert isinstance(jc, ast.JoinClause)
+    assert jc.left.alias == "n1" and jc.right.alias == "n2"
+
+
+def test_parse_errors():
+    with pytest.raises(SqlError):
+        parse_sql("select from where")
+    with pytest.raises(SqlError):
+        parse_sql("select 'unterminated")
+    with pytest.raises(SqlError):
+        parse_sql("frobnicate the database")
+
+
+def test_parse_distinct_and_count_distinct():
+    s = parse_sql("select count(distinct ps_suppkey) from partsupp")
+    agg = L.find_aggregates(s.projections[0])[0]
+    assert agg.distinct
+    s2 = parse_sql("select distinct p_brand from part")
+    assert s2.distinct
+
+
+def test_parse_explicit_join_on():
+    s = parse_sql(
+        "select * from orders join lineitem on o_orderkey = l_orderkey "
+        "left join part on p_partkey = l_partkey"
+    )
+    j = s.from_
+    assert isinstance(j, ast.JoinClause) and j.kind == "left"
+    assert isinstance(j.left, ast.JoinClause) and j.left.kind == "inner"
