@@ -199,19 +199,21 @@ class Expr:
         return type(self) is type(other) and self._key() == other._key()
 
     def _key(self):
-        vals = []
-        for f in dataclasses.fields(self):  # type: ignore[arg-type]
-            v = getattr(self, f.name)
+        # Expr.__eq__ is builder sugar (returns a truthy BinaryExpr), so keys
+        # must normalize Exprs at ANY nesting depth — e.g. Case.branches is a
+        # tuple of (cond, value) tuples — or tuple comparison would call the
+        # sugar and treat all exprs as equal.
+        def norm(v):
             if isinstance(v, Expr):
-                v = ("expr", type(v).__name__, v._key())
-            elif isinstance(v, tuple):
-                v = tuple(
-                    ("expr", type(x).__name__, x._key()) if isinstance(x, Expr)
-                    else x
-                    for x in v
-                )
-            vals.append(v)
-        return tuple(vals)
+                return ("expr", type(v).__name__, v._key())
+            if isinstance(v, tuple):
+                return tuple(norm(x) for x in v)
+            return v
+
+        return tuple(
+            norm(getattr(self, f.name))
+            for f in dataclasses.fields(self)  # type: ignore[arg-type]
+        )
 
 
 def _wrap(v) -> Expr:

@@ -243,13 +243,13 @@ def eliminate_cross_joins(plan: LogicalPlan) -> LogicalPlan:
     remaining = relations[1:]
     unused = list(conjuncts)
     while remaining:
-        placed_schema = placed.schema()
+        ls, lq = placed.schema(), _qualifiers(placed)
         best = None
         for rel in remaining:
-            rs = rel.schema()
+            rs, rq = rel.schema(), _qualifiers(rel)
             keys = []
             for c in unused:
-                pair = _equi_pair_between(c, placed_schema, rs)
+                pair = _equi_pair_between(c, ls, lq, rs, rq)
                 if pair is not None:
                     keys.append((c, pair))
             if keys:
@@ -297,8 +297,37 @@ def _resolvable(schema: Schema, name: str) -> bool:
         return False
 
 
+def _qualifiers(plan: LogicalPlan) -> set[str]:
+    """Table names/aliases a plan subtree exposes. Used to gate the
+    qualified-name fallback of column resolution: ``points.k`` must not
+    resolve against a subtree that doesn't contain relation ``points``
+    merely because some relation there has a bare column ``k``."""
+    if isinstance(plan, TableScan):
+        return {plan.table_name}
+    if isinstance(plan, SubqueryAlias):
+        return {plan.alias}
+    out: set[str] = set()
+    for c in plan.children():
+        out |= _qualifiers(c)
+    return out
+
+
+def _resolvable_on(schema: Schema, quals: set[str], name: str) -> bool:
+    """Like ``_resolvable`` but qualifier-aware: a qualified name ``q.b``
+    may only fall back to base-name-matching a bare field ``b`` if relation
+    ``q`` (a member of ``quals``, see ``_qualifiers``) is in the subtree."""
+    if "." in name and not any(f.name == name for f in schema.fields):
+        if name.rsplit(".", 1)[0] not in quals:
+            return False
+    return _resolvable(schema, name)
+
+
 def _equi_pair_between(
-    c: L.Expr, ls: Schema, rs: Schema
+    c: L.Expr,
+    ls: Schema,
+    lq: set[str],
+    rs: Schema,
+    rq: set[str],
 ) -> tuple[L.Column, L.Column] | None:
     if not (isinstance(c, L.BinaryExpr) and c.op == L.Operator.EQ):
         return None
@@ -307,8 +336,8 @@ def _equi_pair_between(
         return None
     # strictly one side each (a column ambiguous across both sides is not a
     # join key)
-    a_l, a_r = _resolvable(ls, a.cname), _resolvable(rs, a.cname)
-    b_l, b_r = _resolvable(ls, b.cname), _resolvable(rs, b.cname)
+    a_l, a_r = _resolvable_on(ls, lq, a.cname), _resolvable_on(rs, rq, a.cname)
+    b_l, b_r = _resolvable_on(ls, lq, b.cname), _resolvable_on(rs, rq, b.cname)
     if a_l and not a_r and b_r and not b_l:
         return (a, b)
     if b_l and not b_r and a_r and not a_l:
@@ -387,8 +416,8 @@ def _push_conjuncts(
             return Filter(inner, _conjoin(kept)), []
         return inner, []
     if isinstance(plan, (Join, CrossJoin)):
-        ls = (plan.left if isinstance(plan, Join) else plan.left).schema()
-        rs = (plan.right if isinstance(plan, Join) else plan.right).schema()
+        ls, rs = plan.left.schema(), plan.right.schema()
+        lq, rq = _qualifiers(plan.left), _qualifiers(plan.right)
         left_push, right_push, kept = [], [], []
         semi = isinstance(plan, Join) and plan.join_type in (
             JoinType.SEMI, JoinType.ANTI,
@@ -401,8 +430,10 @@ def _push_conjuncts(
         )
         for c in conjuncts:
             cols = L.find_columns(c)
-            on_left = all(_resolvable(ls, n) for n in cols)
-            on_right = all(_resolvable(rs, n) for n in cols) and not semi
+            on_left = all(_resolvable_on(ls, lq, n) for n in cols)
+            on_right = (
+                all(_resolvable_on(rs, rq, n) for n in cols) and not semi
+            )
             # pushing below an outer join's preserved side changes results
             if on_left and not outer_right:
                 left_push.append(c)
@@ -546,8 +577,9 @@ def _prune(plan: LogicalPlan, required: set[str] | None) -> LogicalPlan:
         else:
             need = required | extra
             ls, rs = plan.left.schema(), plan.right.schema()
-            lreq = {n for n in need if _resolvable(ls, n)}
-            rreq = {n for n in need if _resolvable(rs, n)}
+            lq, rq = _qualifiers(plan.left), _qualifiers(plan.right)
+            lreq = {n for n in need if _resolvable_on(ls, lq, n)}
+            rreq = {n for n in need if _resolvable_on(rs, rq, n)}
         return plan.with_children(
             [_prune(plan.left, lreq), _prune(plan.right, rreq)]
         )

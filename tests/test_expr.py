@@ -275,3 +275,26 @@ def test_string_col_vs_col_merged_dicts():
     xs = ["a", "b", "c", "d"] * 5
     ys = ["b", "x", "a", "c"] * 5
     np.testing.assert_array_equal(vals, np.asarray([x == y for x, y in zip(xs, ys)]))
+
+
+def test_same_as_distinguishes_nested_case_branches():
+    """Regression: Case.branches is a tuple of (cond, value) TUPLES; _key()
+    must normalize Exprs at any depth or the __eq__ builder sugar (truthy
+    BinaryExpr) makes every CASE compare equal — which collapsed q12's two
+    sum(CASE ...) aggregates into one."""
+    import ballista_tpu.expr.logical as L
+
+    a = L.col("a")
+    hi = L.Case(
+        branches=((L.BinaryExpr(a, L.Operator.EQ, L.lit(1)), L.lit(1)),),
+        otherwise=L.lit(0),
+    )
+    lo = L.Case(
+        branches=((L.BinaryExpr(a, L.Operator.NEQ, L.lit(1)), L.lit(1)),),
+        otherwise=L.lit(0),
+    )
+    assert hi.same_as(hi)
+    assert not hi.same_as(lo)
+    s_hi = L.AggregateExpr(L.AggFunc.SUM, hi, False)
+    s_lo = L.AggregateExpr(L.AggFunc.SUM, lo, False)
+    assert not s_hi.same_as(s_lo)
