@@ -27,9 +27,16 @@ from ballista_tpu.errors import ExecutionError, PlanError
 from ballista_tpu.exec.base import ExecutionPlan, TaskContext
 from ballista_tpu.expr import logical as L
 from ballista_tpu.expr.physical import compile_expr
+from ballista_tpu.columnar.batch import round_capacity
 from ballista_tpu.ops.compact import compact
 from ballista_tpu.ops.concat import concat_batches
-from ballista_tpu.ops.join import JoinSide, build_side, probe_side
+from ballista_tpu.ops.join import (
+    JoinSide,
+    build_side,
+    expand_join,
+    probe_counts,
+    probe_side,
+)
 from ballista_tpu.plan.logical import JoinType
 
 
@@ -51,6 +58,27 @@ def _jit_probe(probe_keys: tuple, kind: JoinSide):
     return jax.jit(
         lambda bt, pb: probe_side(bt, pb, list(probe_keys), kind)
     )
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_counts(probe_keys: tuple):
+    return jax.jit(
+        lambda bt, pb: probe_counts(bt, pb, list(probe_keys))
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_expand_total(preserve_probe: bool):
+    """Output rows the expansion will need (host-fetched for sizing)."""
+
+    def f(pb, count):
+        if preserve_probe:  # LEFT: unmatched live probe rows emit one row
+            eff = jnp.where(pb.valid, jnp.maximum(count, 1), 0)
+        else:
+            eff = count
+        return jnp.sum(eff)
+
+    return jax.jit(f)
 
 
 class HashJoinExec(ExecutionPlan):
@@ -161,18 +189,19 @@ class HashJoinExec(ExecutionPlan):
                 # rebuild only when dictionary remapping changed the build
                 with self.metrics.time("build_time"):
                     bt = build_side(bb, right_keys)
-                bt.check_unique()
+                bt.check_overflow()
                 build_batch = bb
-            out = self._probe_with_filter(bt, pb, left_keys, kind)
+            out = self._probe_or_expand(bt, pb, left_keys, kind)
             self.metrics.add("output_batches")
             yield out
 
     def _execute_inner(
         self, partition, ctx, left_keys, right_keys
     ) -> Iterator[DeviceBatch]:
-        """INNER: build the right side; if it has duplicate keys, build the
-        left instead (the kernel needs a unique PK side; there are no table
-        statistics yet, so detect at runtime) and restore column order."""
+        """INNER: build the right side. If it has duplicate keys, prefer
+        flipping to build a unique left side (fixed-capacity probe, no
+        expansion); if BOTH sides have duplicates, run the m:n expansion
+        join with the right side as build."""
         with self.metrics.time("build_time"):
             right_batch = _collect(self.right, ctx)
 
@@ -185,43 +214,169 @@ class HashJoinExec(ExecutionPlan):
         with self.metrics.time("build_time"):
             bt = build_side(bb, right_keys)
         if bool(bt.has_dups) or bool(bt.run_overflow):
-            # flip: build left (collect all partitions), probe right. The
-            # flip decision is deterministic across partitions, so emit all
-            # output from partition 0 and nothing elsewhere.
+            # Right side can't serve as a unique build (dups, or a hash-mode
+            # collision run past the probe window). Deterministic across
+            # partitions: emit all output from partition 0, nothing
+            # elsewhere.
             if partition != 0:
                 return
             with self.metrics.time("build_time"):
                 left_batch = _collect(self.left, ctx)
-            build_keys, probe_keys = left_keys, right_keys
-            build_is_right = False
-            probes = (
-                b
-                for p in range(self.right.output_partitioning().n)
-                for b in self.right.execute(p, ctx)
+            lb, rb = self._unify_key_dicts(
+                left_batch, right_batch, left_keys, right_keys
             )
-            base, bt = left_batch, None
-        else:
-            build_keys, probe_keys = right_keys, left_keys
-            build_is_right = True
-
-            def _rest():
-                yield first
-                yield from iter_first
-
-            probes = _rest()
-            base = bb
-
-        for b in probes:
-            bb2, pb = self._unify_key_dicts(base, b, build_keys, probe_keys)
-            if bt is None or bb2 is not base:
+            with self.metrics.time("build_time"):
+                lbt = build_side(lb, left_keys)
+            if not bool(lbt.has_dups) and not bool(lbt.run_overflow):
+                # flip: build (unique) left, probe the collected right
+                joined = self._probe_with_filter(
+                    lbt, rb, right_keys, JoinSide.INNER
+                )
+                out = self._restore_column_order(
+                    joined, rb, lbt.batch, build_is_right=False
+                )
+                self.metrics.add("output_batches")
+                yield out
+                return
+            # both sides duplicated: m:n expansion, building whichever side
+            # has no collision overflow (expansion needs countable runs)
+            if bool(bt.run_overflow) and not bool(lbt.run_overflow):
+                joined = self._expand_with_filter(
+                    lbt, rb, right_keys, JoinSide.INNER
+                )
+                out = self._restore_column_order(
+                    joined, rb, lbt.batch, build_is_right=False
+                )
+            else:
                 with self.metrics.time("build_time"):
-                    bt = build_side(bb2, build_keys)
-                bt.check_unique()
-                base = bb2
-            joined = self._probe_with_filter(bt, pb, probe_keys, JoinSide.INNER)
-            out = self._restore_column_order(joined, pb, bt.batch, build_is_right)
+                    rbt = build_side(rb, right_keys)
+                rbt.check_overflow()
+                out = self._expand_with_filter(
+                    rbt, lb, left_keys, JoinSide.INNER
+                )
             self.metrics.add("output_batches")
             yield out
+            return
+
+        base = bb
+
+        def _rest():
+            yield first
+            yield from iter_first
+
+        for b in _rest():
+            bb2, pb = self._unify_key_dicts(base, b, right_keys, left_keys)
+            if bb2 is not base:
+                with self.metrics.time("build_time"):
+                    bt = build_side(bb2, right_keys)
+                bt.check_unique()
+                base = bb2
+            joined = self._probe_with_filter(bt, pb, left_keys, JoinSide.INNER)
+            out = self._restore_column_order(joined, pb, bt.batch, True)
+            self.metrics.add("output_batches")
+            yield out
+
+    # -- expansion (duplicate-build) path -------------------------------------
+    def _probe_or_expand(
+        self, bt, probe: DeviceBatch, probe_keys: list[int], kind: JoinSide
+    ) -> DeviceBatch:
+        """Unique build -> fixed-capacity probe; duplicated build -> m:n
+        expansion (ref: DataFusion HashJoinExec m:n semantics, serde
+        physical_plan mod.rs:438-523)."""
+        if not bool(bt.has_dups):
+            return self._probe_with_filter(bt, probe, probe_keys, kind)
+        return self._expand_with_filter(bt, probe, probe_keys, kind)
+
+    def _expand_with_filter(
+        self, bt, probe: DeviceBatch, probe_keys: list[int], kind: JoinSide
+    ) -> DeviceBatch:
+        """Expansion join: count matches per probe row, size the output on
+        host (bucketed static capacity), then one jitted expand+filter+
+        finalize program. SEMI/ANTI never expand without a residual filter
+        (the match bit is enough)."""
+        with self.metrics.time("probe_time"):
+            first, count, live = _jit_counts(tuple(probe_keys))(bt, probe)
+
+        if kind in (JoinSide.SEMI, JoinSide.ANTI) and self.filter is None:
+            key = (tuple(probe_keys), kind, "semi_counts")
+            fn = self._filtered_probe_cache.get(key)
+            if fn is None:
+                keep_match = kind == JoinSide.SEMI
+
+                def fn(pb, count):
+                    m = count > 0
+                    return pb.with_valid(
+                        pb.valid & (m if keep_match else ~m)
+                    )
+
+                fn = jax.jit(fn)
+                self._filtered_probe_cache[key] = fn
+            with self.metrics.time("probe_time"):
+                return fn(probe, count)
+
+        preserve = kind == JoinSide.LEFT
+        with self.metrics.time("probe_time"):
+            total = int(_jit_expand_total(preserve)(probe, count))
+        out_cap = round_capacity(max(total, 1))
+
+        key = (tuple(probe_keys), kind, out_cap)
+        fn = self._filtered_probe_cache.get(key)
+        if fn is None:
+            filt = self.filter
+
+            def run(bt, pb, first, count):
+                if kind == JoinSide.LEFT:
+                    eff = jnp.where(pb.valid, jnp.maximum(count, 1), 0)
+                    ekind = JoinSide.LEFT
+                else:
+                    # INNER, or SEMI/ANTI with residual filter: pairs only
+                    eff = count
+                    ekind = JoinSide.INNER
+                batch, i, k, real = expand_join(
+                    bt, pb, first, count, eff, out_cap, ekind
+                )
+                if filt is None:
+                    return batch  # INNER/LEFT, finalized by expand_join
+                cv = compile_expr(filt, batch.schema).evaluate(batch)
+                passes = cv.values.astype(bool)
+                if cv.nulls is not None:
+                    passes = passes & ~cv.nulls
+                passes = passes & real
+                if kind == JoinSide.INNER:
+                    return batch.with_valid(batch.valid & passes)
+                # any passing match per probe row (scatter-max)
+                ap = (
+                    jnp.zeros(pb.capacity, dtype=bool)
+                    .at[i]
+                    .max(passes, mode="drop")
+                )
+                if kind == JoinSide.SEMI:
+                    return pb.with_valid(pb.valid & ap)
+                if kind == JoinSide.ANTI:
+                    return pb.with_valid(pb.valid & ~ap)
+                # LEFT with residual filter: keep passing rows; probe rows
+                # with no passing match keep their k==0 row, build side
+                # nulled (LEFT JOIN ... ON key AND residual semantics, q13)
+                null_row = (k == 0) & ~ap[i] & batch.valid
+                new_valid = batch.valid & (passes | null_row)
+                n_probe = len(pb.schema)
+                nulls = list(batch.nulls)
+                for ci in range(n_probe, len(batch.schema)):
+                    m = nulls[ci]
+                    miss = ~passes
+                    nulls[ci] = miss if m is None else (m | miss)
+                return DeviceBatch(
+                    schema=batch.schema,
+                    columns=batch.columns,
+                    valid=new_valid,
+                    nulls=tuple(nulls),
+                    dictionaries=dict(batch.dictionaries),
+                )
+
+            fn = jax.jit(run)
+            self._filtered_probe_cache[key] = fn
+        with self.metrics.time("probe_time"):
+            return fn(bt, probe, first, count)
 
     def _probe_with_filter(
         self, bt, probe: DeviceBatch, probe_keys: list[int], kind: JoinSide

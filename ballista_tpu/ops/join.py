@@ -15,8 +15,14 @@ COLLECT_LEFT / PARTITIONED in ballista.proto:474-487). TPU-native design:
   detected at build and raised host-side).
 
 Supports INNER / LEFT (probe-preserving) / SEMI / ANTI with a unique build
-side — the PK-FK shape of every TPC-H join. Duplicate build keys are
-detected on device and raised host-side (expansion joins are a later tier).
+side — the PK-FK fast path — plus **expansion joins** for duplicate build
+keys (m:n): ``probe_counts`` finds each probe row's match run via two-sided
+``searchsorted`` (exact packing) or a window scan (hashed packing), and
+``expand_join`` materializes the output with a prefix-sum + gather into a
+statically-bucketed capacity (the classic TPU expand: cumsum + searchsorted
+row assignment, no data-dependent shapes inside jit). Single int keys pack
+exactly; two int keys in 31/32-bit range pack exactly as hi<<32|lo
+(``exact2``); everything else hashes with window-verified probes.
 """
 
 from __future__ import annotations
@@ -74,11 +80,28 @@ def _exact_pack(cols: list[jnp.ndarray]) -> bool:
     return len(cols) == 1 and jnp.issubdtype(cols[0].dtype, jnp.integer)
 
 
-def _pack_key(cols: list[jnp.ndarray]) -> jnp.ndarray:
-    """Rows -> int64 key. Single integer column is exact; multi-column uses a
-    64-bit hash (candidates are verified against actual columns at probe)."""
-    if _exact_pack(cols):
+def _pack_key(cols: list[jnp.ndarray], mode: str = None) -> jnp.ndarray:
+    """Rows -> int64 key under a packing mode:
+
+    - ``exact``: single integer column, identity (injective);
+    - ``exact2``: two integer columns with a in [0, 2^31) and b in [0, 2^32)
+      packed a<<32 | b (injective; out-of-range PROBE values map to -1 which
+      is below every in-range build key, so they never match — correct SQL
+      semantics since the build side was range-checked);
+    - ``hash``: 64-bit hash (probe verifies candidates against actual
+      columns).
+    """
+    if mode is None:
+        mode = "exact" if _exact_pack(cols) else "hash"
+    if mode == "exact":
         return cols[0].astype(jnp.int64)
+    if mode == "exact2":
+        a = cols[0].astype(jnp.int64)
+        b = cols[1].astype(jnp.int64)
+        in_range = (
+            (a >= 0) & (a < 2**31) & (b >= 0) & (b < jnp.int64(2**32))
+        )
+        return jnp.where(in_range, (a << 32) | b, jnp.int64(-1))
     return hash_columns(cols).view(jnp.int64)
 
 
@@ -93,24 +116,29 @@ class BuildTable:
     key_cols: list[jnp.ndarray]  # actual key columns, sorted order
     key_idxs: list[int]  # key column indices into batch.schema
     n: jnp.ndarray  # int32 scalar: live build rows
-    exact: bool  # packed key is injective (window scan skipped)
+    mode: str  # packing mode: "exact" | "exact2" | "hash"
     has_dups: jnp.ndarray  # bool scalar: duplicate keys among live rows
     run_overflow: jnp.ndarray  # bool scalar: collision run > COLLISION_WINDOW
+
+    @property
+    def exact(self) -> bool:
+        """Packed key is injective (window scan skipped)."""
+        return self.mode != "hash"
 
     def tree_flatten(self):
         leaves = (
             self.batch, self.keys, self.key_cols, self.n,
             self.has_dups, self.run_overflow,
         )
-        return leaves, (tuple(self.key_idxs), self.exact)
+        return leaves, (tuple(self.key_idxs), self.mode)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
         batch, keys, key_cols, n, has_dups, run_overflow = leaves
-        key_idxs, exact = aux
+        key_idxs, mode = aux
         return cls(
             batch=batch, keys=keys, key_cols=list(key_cols),
-            key_idxs=list(key_idxs), n=n, exact=exact,
+            key_idxs=list(key_idxs), n=n, mode=mode,
             has_dups=has_dups, run_overflow=run_overflow,
         )
 
@@ -120,6 +148,9 @@ class BuildTable:
                 "join build side has duplicate keys; only unique-build "
                 "(PK-FK) joins are supported on device in this version"
             )
+        self.check_overflow()
+
+    def check_overflow(self) -> None:
         if bool(self.run_overflow):
             raise ExecutionError(
                 "join build side has a packed-hash collision run longer "
@@ -129,7 +160,8 @@ class BuildTable:
 
 
 @functools.lru_cache(maxsize=None)
-def _build_prep_program(key_idxs: tuple, cap: int, schema_key: tuple):
+def _build_prep_program(key_idxs: tuple, cap: int, schema_key: tuple,
+                        mode: str):
     """(batch) -> (dead flag, packed key): the sort-pass operands."""
 
     def f(batch: DeviceBatch):
@@ -138,14 +170,28 @@ def _build_prep_program(key_idxs: tuple, cap: int, schema_key: tuple):
             nm = batch.nulls[i]
             if nm is not None:
                 valid = valid & ~nm
-        packed = _pack_key([batch.columns[i] for i in key_idxs])
+        packed = _pack_key([batch.columns[i] for i in key_idxs], mode)
         return ~valid, packed
 
     return jax.jit(f)
 
 
+@functools.lru_cache(maxsize=None)
+def _exact2_range_program(cap: int):
+    """Whether both (masked) int key columns fit the exact2 pack ranges."""
+
+    def f(a, b, live):
+        a = jnp.where(live, a.astype(jnp.int64), 0)
+        b = jnp.where(live, b.astype(jnp.int64), 0)
+        return jnp.all(
+            (a >= 0) & (a < 2**31) & (b >= 0) & (b < jnp.int64(2**32))
+        )
+
+    return jax.jit(f)
+
+
 def _build_finish(perm, dead, packed, batch: DeviceBatch, key_idxs: tuple,
-                  exact: bool) -> BuildTable:
+                  mode: str) -> BuildTable:
     """Jitted finisher after the sort passes (no sort in here)."""
     cap = batch.capacity
     iota = jnp.arange(cap, dtype=jnp.int32)
@@ -167,12 +213,11 @@ def _build_finish(perm, dead, packed, batch: DeviceBatch, key_idxs: tuple,
     )
     sorted_key_cols = [cols[i] for i in key_idxs]
 
-    # Duplicate actual keys may be separated inside a packed-collision run,
-    # so compare each row against the next COLLISION_WINDOW-1 rows of its
-    # run (vector shifts, no gathers). With exact packing adjacent suffices.
-    scan = 1 if exact else COLLISION_WINDOW - 1
+    # Equal actual keys are always adjacent after the sort (exact packing is
+    # injective; hash mode tie-breaks on the actual key columns), so one
+    # adjacent compare detects duplicates in every mode.
     dup = jnp.zeros((), dtype=bool)
-    for j in range(1, scan + 1):
+    for j in range(1, 2):
         pair_live = valid_sorted[j:] & valid_sorted[:-j]
         same_run = keys_sorted[j:] == keys_sorted[:-j]
         eq = jnp.ones(cap - j, dtype=bool)
@@ -180,7 +225,7 @@ def _build_finish(perm, dead, packed, batch: DeviceBatch, key_idxs: tuple,
             eq = eq & (kc[j:] == kc[:-j])
         dup = dup | jnp.any(pair_live & same_run & eq)
 
-    if exact:
+    if mode != "hash":
         run_overflow = jnp.zeros((), dtype=bool)
     else:
         # Length of each equal-packed run among live rows; probe scans a
@@ -199,15 +244,37 @@ def _build_finish(perm, dead, packed, batch: DeviceBatch, key_idxs: tuple,
         key_cols=sorted_key_cols,
         key_idxs=list(key_idxs),
         n=n,
-        exact=exact,
+        mode=mode,
         has_dups=dup,
         run_overflow=run_overflow,
     )
 
 
 _build_finish_jit = jax.jit(
-    _build_finish, static_argnames=("key_idxs", "exact")
+    _build_finish, static_argnames=("key_idxs", "mode")
 )
+
+
+def _choose_pack_mode(batch: DeviceBatch, key_idxs: list[int]) -> str:
+    """Pick the packing mode. exact2 needs a host-side range check (one
+    scalar sync, amortized: the same shapes reuse the cached programs)."""
+    key_cols = [batch.columns[i] for i in key_idxs]
+    if _exact_pack(key_cols):
+        return "exact"
+    if len(key_cols) == 2 and all(
+        jnp.issubdtype(c.dtype, jnp.integer) for c in key_cols
+    ):
+        live = batch.valid
+        for i in key_idxs:
+            nm = batch.nulls[i]
+            if nm is not None:
+                live = live & ~nm
+        ok = _exact2_range_program(batch.capacity)(
+            key_cols[0], key_cols[1], live
+        )
+        if bool(ok):
+            return "exact2"
+    return "hash"
 
 
 def build_side(batch: DeviceBatch, key_idxs: list[int]) -> BuildTable:
@@ -215,16 +282,20 @@ def build_side(batch: DeviceBatch, key_idxs: list[int]) -> BuildTable:
     SQL equality: NULL keys never match anything — such rows are dead."""
     from ballista_tpu.ops.perm import multi_key_perm
 
-    key_cols = [batch.columns[i] for i in key_idxs]
-    exact = _exact_pack(key_cols)
+    mode = _choose_pack_mode(batch, key_idxs)
     schema_key = tuple(f.dtype.value for f in batch.schema)
     dead, packed = _build_prep_program(
-        tuple(key_idxs), batch.capacity, schema_key
+        tuple(key_idxs), batch.capacity, schema_key, mode
     )(batch)
-    # Dead rows last; live rows ordered by packed key.
-    perm = multi_key_perm([(dead, False), (packed, False)])
+    # Dead rows last; live rows ordered by packed key. Hash mode tie-breaks
+    # on the actual key columns so duplicate keys land adjacent (expansion
+    # joins need contiguous match runs; dup detection needs one compare).
+    passes = [(dead, False), (packed, False)]
+    if mode == "hash":
+        passes.extend((batch.columns[i], False) for i in key_idxs)
+    perm = multi_key_perm(passes)
     return _build_finish_jit(
-        perm, dead, packed, batch, tuple(key_idxs), exact
+        perm, dead, packed, batch, tuple(key_idxs), mode
     )
 
 
@@ -238,7 +309,7 @@ def probe_side(
     """Probe and construct the joined batch (probe-capacity output)."""
     _check_join_dictionaries(build, probe, probe_key_idxs)
     probe_keys = [probe.columns[i] for i in probe_key_idxs]
-    packed = _pack_key(probe_keys)
+    packed = _pack_key(probe_keys, build.mode)
     idx = jnp.searchsorted(build.keys, packed)
     cap_b = build.keys.shape[0]
 
@@ -300,3 +371,115 @@ def probe_side(
         nulls=out_nulls,
         dictionaries=dicts,
     )
+
+
+# -- expansion (m:n) joins ----------------------------------------------------
+
+
+def probe_counts(
+    build: BuildTable, probe: DeviceBatch, probe_key_idxs: list[int]
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per probe row: (first matching build row, match count, live flag).
+
+    Exact packing: the match run is exactly the packed-key run, found with a
+    two-sided ``searchsorted`` — supports arbitrary duplication. Hash
+    packing: window scan (runs are bounded by COLLISION_WINDOW, enforced at
+    build); equal keys are contiguous thanks to the build tie-break sort.
+    """
+    _check_join_dictionaries(build, probe, probe_key_idxs)
+    probe_keys = [probe.columns[i] for i in probe_key_idxs]
+    packed = _pack_key(probe_keys, build.mode)
+    live = probe.valid
+    for pk_i in probe_key_idxs:
+        nm = probe.nulls[pk_i]
+        if nm is not None:
+            live = live & ~nm
+    cap_b = build.keys.shape[0]
+
+    if build.mode != "hash":
+        lo = jnp.searchsorted(build.keys, packed, side="left")
+        hi = jnp.searchsorted(build.keys, packed, side="right")
+        # Dead tail keys are INT64_MAX; clamping to n keeps a probe key of
+        # INT64_MAX from matching dead slots.
+        lo = jnp.minimum(lo, build.n).astype(jnp.int32)
+        hi = jnp.minimum(hi, build.n).astype(jnp.int32)
+        count = jnp.where(live, hi - lo, 0).astype(jnp.int32)
+        return lo, count, live
+
+    idx = jnp.searchsorted(build.keys, packed)
+    first = jnp.zeros(probe.capacity, jnp.int32)
+    found = jnp.zeros(probe.capacity, dtype=bool)
+    count = jnp.zeros(probe.capacity, jnp.int32)
+    for j in range(COLLISION_WINDOW):
+        cand_j = jnp.clip(idx + j, 0, cap_b - 1)
+        ok = (idx + j < build.n) & live
+        for bk, pk in zip(build.key_cols, probe_keys):
+            ok = ok & (bk[cand_j] == pk)
+        first = jnp.where(ok & ~found, cand_j.astype(jnp.int32), first)
+        found = found | ok
+        count = count + ok.astype(jnp.int32)
+    return first, count, live
+
+
+def expand_join(
+    build: BuildTable,
+    probe: DeviceBatch,
+    first: jnp.ndarray,
+    count: jnp.ndarray,
+    eff: jnp.ndarray,
+    out_cap: int,
+    join_type: JoinSide,
+) -> tuple[DeviceBatch, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Materialize the m:n join output (probe ++ build columns).
+
+    ``eff`` = output rows per probe row (INNER: ``count``; LEFT:
+    ``max(count, 1)`` over preserved rows). ``out_cap`` is the static output
+    capacity (host-sized from ``sum(eff)``, bucketed). Returns
+    ``(batch, i, k, real)`` where ``i`` is the source probe row per output
+    row, ``k`` the match ordinal within its run, and ``real`` whether the
+    row is an actual key match (vs a LEFT null-extension row).
+    """
+    cap_b = build.keys.shape[0]
+    cap_p = probe.capacity
+    inc = jnp.cumsum(eff.astype(jnp.int32))
+    total = inc[-1]
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    i = jnp.searchsorted(inc, j, side="right").astype(jnp.int32)
+    i = jnp.clip(i, 0, cap_p - 1)
+    start = inc[i] - eff[i]
+    k = j - start
+    valid_out = j < total
+    real = valid_out & (k < count[i])
+    bidx = jnp.clip(first[i] + k, 0, cap_b - 1)
+
+    b = build.batch
+    out_cols = tuple(c[i] for c in probe.columns) + tuple(
+        c[bidx] for c in b.columns
+    )
+    out_nulls: list[jnp.ndarray | None] = [
+        None if m is None else m[i] for m in probe.nulls
+    ]
+    for m in b.nulls:
+        if join_type == JoinSide.LEFT:
+            gm = ~real if m is None else (m[bidx] | ~real)
+        else:
+            gm = None if m is None else m[bidx]
+        out_nulls.append(gm)
+
+    schema = probe.schema.join(b.schema)
+    dicts = dict(b.dictionaries)
+    for name, d in probe.dictionaries.items():
+        if name in dicts and dicts[name].values != d.values:
+            raise ExecutionError(
+                f"string column {name!r} exists on both join sides with "
+                "different dictionaries; rename/disambiguate before joining"
+            )
+        dicts[name] = d
+    batch = DeviceBatch(
+        schema=schema,
+        columns=out_cols,
+        valid=valid_out if join_type != JoinSide.INNER else real,
+        nulls=tuple(out_nulls),
+        dictionaries=dicts,
+    )
+    return batch, i, k, real

@@ -172,10 +172,10 @@ class SqlPlanner:
         for p in projections:
             agg_nodes.extend(L.find_aggregates(p))
         if having is not None:
-            # HAVING may contain scalar subqueries (q11) — eliminate first.
-            plan2, having = self._plan_predicate(plan, having, outer, filter_now=False)
-            plan = plan2
-            in_schema = plan.schema()
+            # ScalarSubquery nodes are leaves here; their elimination happens
+            # AFTER aggregation (q11: the subquery joins against the
+            # aggregate's output, not its input — otherwise the synthetic
+            # __sqN column would be dropped by the Aggregate schema).
             agg_nodes.extend(L.find_aggregates(having))
         for ob in s.order_by:
             agg_nodes.extend(L.find_aggregates(ob.expr))
@@ -183,6 +183,10 @@ class SqlPlanner:
         if agg_nodes or group_exprs:
             plan, projections, having = self._plan_aggregate(
                 plan, group_exprs, projections, having, alias_map
+            )
+        if having is not None:
+            plan, having = self._plan_predicate(
+                plan, having, outer, filter_now=False
             )
             if having is not None:
                 plan = Filter(plan, having)
@@ -518,6 +522,17 @@ class SqlPlanner:
                     isinstance(e, L.Column) and e.cname == n for e in out_exprs
                 )
             ]
+            # Residual correlated predicates (q21: l2.l_suppkey <>
+            # l1.l_suppkey) are evaluated as a join filter AFTER the
+            # decorrelation join — their inner columns must survive the
+            # projection.
+            plan_schema = plan.schema()
+            for r in residual:
+                for n in L.find_columns(r):
+                    if _resolvable(plan_schema, n) and not any(
+                        isinstance(e, L.Column) and e.cname == n for e in keep
+                    ):
+                        keep.append(L.Column(n))
             if q.distinct or True:
                 # Semi/anti/inner-join consumers only need distinct keys;
                 # dedup protects the unique-build join kernel.

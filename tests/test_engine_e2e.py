@@ -203,3 +203,26 @@ def test_distinct(env):
         "select distinct l_returnflag from lineitem"
     ).collect().to_pandas()
     assert set(res.l_returnflag) == set(f["lineitem"].l_returnflag.unique())
+
+
+def test_q11_having_scalar_subquery(env):
+    """Regression: the HAVING scalar subquery must join against the
+    aggregate's output (a dangling __sqN column used to be dropped by the
+    Aggregate schema). GERMANY has no suppliers at SF=0.002, so rewrite to a
+    nation that does."""
+    ctx, f = env
+    j = (
+        f["partsupp"]
+        .merge(f["supplier"], left_on="ps_suppkey", right_on="s_suppkey")
+        .merge(f["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    )
+    nat = j.n_name.value_counts().index[0]
+    sql = (QDIR / "q11.sql").read_text().replace("GERMANY", nat)
+    res = ctx.sql(sql).collect().to_pandas()
+    jj = j[j.n_name == nat].copy()
+    jj["value"] = jj.ps_supplycost * jj.ps_availqty
+    g = jj.groupby("ps_partkey")["value"].sum()
+    w = g[g > jj["value"].sum() * 0.0001].sort_values(ascending=False)
+    assert len(res) == len(w) > 0
+    np.testing.assert_array_equal(res.ps_partkey.to_numpy(), w.index.to_numpy())
+    np.testing.assert_allclose(res["value"].to_numpy(), w.to_numpy(), rtol=1e-9)

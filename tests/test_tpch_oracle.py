@@ -1,0 +1,682 @@
+"""Oracle correctness for ALL 22 TPC-H queries vs pandas reference.
+
+The reference pins correctness with golden pretty-printed results against
+fixtures (ballista/rust/client/src/context.rs:441-943) plus the TPC-H
+docker integration run (dev/integration-tests.sh). Here every query's
+result is recomputed in pandas at SF=0.002 and compared column-by-column.
+
+Spec constants that select nothing at this tiny scale (q11's GERMANY,
+q18's 300-quantity threshold, q20's CANADA/forest%, q22's country codes)
+are substituted with values chosen FROM the generated data so the engine
+path under test is never trivially empty.
+"""
+
+import datetime
+import pathlib
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ballista_tpu.exec.context import TpuContext
+from ballista_tpu.tpch import gen_all
+
+QDIR = pathlib.Path(__file__).resolve().parent.parent / "benchmarks" / "queries"
+SCALE = 0.002
+
+D = datetime.date
+
+
+@pytest.fixture(scope="module")
+def env():
+    ctx = TpuContext()
+    data = gen_all(scale=SCALE)
+    for name, t in data.items():
+        ctx.register_table(name, t)
+    frames = {k: v.to_pandas() for k, v in data.items()}
+    return ctx, frames
+
+
+def q(name: str, subst: dict | None = None) -> str:
+    sql = (QDIR / f"{name}.sql").read_text()
+    for old, new in (subst or {}).items():
+        assert old in sql, f"substitution target {old!r} not in {name}"
+        sql = sql.replace(old, new)
+    return sql
+
+
+def run_sql(ctx, sql: str) -> pd.DataFrame:
+    return ctx.sql(sql).collect().to_pandas()
+
+
+def cmp(res: pd.DataFrame, want: pd.DataFrame, rtol=1e-9):
+    assert len(res) == len(want), f"rows: engine {len(res)} oracle {len(want)}"
+    assert res.shape[1] == want.shape[1], (res.columns, want.columns)
+    for i in range(want.shape[1]):
+        a, b = res.iloc[:, i], want.iloc[:, i]
+        if pd.api.types.is_float_dtype(b) or pd.api.types.is_float_dtype(a):
+            np.testing.assert_allclose(
+                a.to_numpy(dtype=float),
+                b.to_numpy(dtype=float),
+                rtol=rtol,
+                err_msg=f"col {i} ({res.columns[i]})",
+            )
+        else:
+            assert list(a) == list(b), f"col {i} ({res.columns[i]})"
+
+
+def rev(df):
+    return df.l_extendedprice * (1 - df.l_discount)
+
+
+def test_q1(env):
+    ctx, f = env
+    res = run_sql(ctx, q("q1"))
+    d = f["lineitem"]
+    d = d[d.l_shipdate <= D(1998, 12, 1) - datetime.timedelta(days=90)].copy()
+    d["disc_price"] = rev(d)
+    d["charge"] = d.disc_price * (1 + d.l_tax)
+    w = (
+        d.groupby(["l_returnflag", "l_linestatus"])
+        .agg(
+            sum_qty=("l_quantity", "sum"),
+            sum_base_price=("l_extendedprice", "sum"),
+            sum_disc_price=("disc_price", "sum"),
+            sum_charge=("charge", "sum"),
+            avg_qty=("l_quantity", "mean"),
+            avg_price=("l_extendedprice", "mean"),
+            avg_disc=("l_discount", "mean"),
+            count_order=("l_quantity", "count"),
+        )
+        .reset_index()
+        .sort_values(["l_returnflag", "l_linestatus"])
+        .reset_index(drop=True)
+    )
+    cmp(res, w)
+
+
+def test_q2(env):
+    ctx, f = env
+    pa_, s, ps, n, r = (
+        f["part"], f["supplier"], f["partsupp"], f["nation"], f["region"],
+    )
+    eu = (
+        ps.merge(s, left_on="ps_suppkey", right_on="s_suppkey")
+        .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+        .merge(r, left_on="n_regionkey", right_on="r_regionkey")
+    )
+    eu = eu[eu.r_name == "EUROPE"]
+    minc = eu.groupby("ps_partkey").ps_supplycost.min()
+    j = pa_.merge(eu, left_on="p_partkey", right_on="ps_partkey")
+    j = j[(j.p_size == 15) & j.p_type.str.endswith("BRASS")]
+    j = j[j.ps_supplycost == j.p_partkey.map(minc)]
+    w = (
+        j.sort_values(
+            ["s_acctbal", "n_name", "s_name", "p_partkey"],
+            ascending=[False, True, True, True],
+        )
+        .head(100)[
+            ["s_acctbal", "s_name", "n_name", "p_partkey", "p_mfgr",
+             "s_address", "s_phone", "s_comment"]
+        ]
+        .reset_index(drop=True)
+    )
+    res = run_sql(ctx, q("q2"))
+    cmp(res, w)
+
+
+def test_q3(env):
+    ctx, f = env
+    j = f["customer"][f["customer"].c_mktsegment == "BUILDING"].merge(
+        f["orders"], left_on="c_custkey", right_on="o_custkey"
+    )
+    j = j[j.o_orderdate < D(1995, 3, 15)]
+    j = j.merge(
+        f["lineitem"][f["lineitem"].l_shipdate > D(1995, 3, 15)],
+        left_on="o_orderkey",
+        right_on="l_orderkey",
+    )
+    j["revenue"] = rev(j)
+    w = (
+        j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])
+        .revenue.sum()
+        .reset_index()
+        .sort_values(["revenue", "o_orderdate"], ascending=[False, True])
+        .head(10)[["l_orderkey", "revenue", "o_orderdate", "o_shippriority"]]
+        .reset_index(drop=True)
+    )
+    res = run_sql(ctx, q("q3"))
+    cmp(res, w)
+
+
+def test_q4(env):
+    ctx, f = env
+    o = f["orders"]
+    o = o[(o.o_orderdate >= D(1993, 7, 1)) & (o.o_orderdate < D(1993, 10, 1))]
+    li = f["lineitem"]
+    keys = li[li.l_commitdate < li.l_receiptdate].l_orderkey.unique()
+    o = o[o.o_orderkey.isin(keys)]
+    w = (
+        o.groupby("o_orderpriority")
+        .size()
+        .rename("order_count")
+        .reset_index()
+        .sort_values("o_orderpriority")
+        .reset_index(drop=True)
+    )
+    res = run_sql(ctx, q("q4"))
+    cmp(res, w)
+
+
+def test_q5(env):
+    ctx, f = env
+    j = (
+        f["customer"]
+        .merge(f["orders"], left_on="c_custkey", right_on="o_custkey")
+        .merge(f["lineitem"], left_on="o_orderkey", right_on="l_orderkey")
+        .merge(f["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+        .merge(f["nation"], left_on="s_nationkey", right_on="n_nationkey")
+        .merge(f["region"], left_on="n_regionkey", right_on="r_regionkey")
+    )
+    j = j[
+        (j.c_nationkey == j.s_nationkey)
+        & (j.r_name == "ASIA")
+        & (j.o_orderdate >= D(1994, 1, 1))
+        & (j.o_orderdate < D(1995, 1, 1))
+    ]
+    j["revenue"] = rev(j)
+    w = (
+        j.groupby("n_name")
+        .revenue.sum()
+        .reset_index()
+        .sort_values("revenue", ascending=False)
+        .reset_index(drop=True)
+    )
+    res = run_sql(ctx, q("q5"))
+    cmp(res, w)
+
+
+def test_q6(env):
+    ctx, f = env
+    df = f["lineitem"]
+    m = (
+        (df.l_shipdate >= D(1994, 1, 1))
+        & (df.l_shipdate < D(1995, 1, 1))
+        & (df.l_discount >= 0.05)
+        & (df.l_discount <= 0.07)
+        & (df.l_quantity < 24)
+    )
+    w = pd.DataFrame({"revenue": [(df.l_extendedprice * df.l_discount)[m].sum()]})
+    res = run_sql(ctx, q("q6"))
+    cmp(res, w)
+
+
+def _q7_pairs(f):
+    """Pick two nations that actually trade at this scale."""
+    j = (
+        f["supplier"]
+        .merge(f["lineitem"], left_on="s_suppkey", right_on="l_suppkey")
+        .merge(f["orders"], left_on="l_orderkey", right_on="o_orderkey")
+        .merge(f["customer"], left_on="o_custkey", right_on="c_custkey")
+        .merge(
+            f["nation"].add_prefix("s_n_"),
+            left_on="s_nationkey",
+            right_on="s_n_n_nationkey",
+        )
+        .merge(
+            f["nation"].add_prefix("c_n_"),
+            left_on="c_nationkey",
+            right_on="c_n_n_nationkey",
+        )
+    )
+    j = j[
+        (j.l_shipdate >= D(1995, 1, 1)) & (j.l_shipdate <= D(1996, 12, 31))
+    ]
+    pairs = (
+        j[j.s_n_n_name != j.c_n_n_name]
+        .groupby(["s_n_n_name", "c_n_n_name"])
+        .size()
+        .sort_values(ascending=False)
+    )
+    (a, b) = pairs.index[0]
+    return j, a, b
+
+
+def test_q7(env):
+    ctx, f = env
+    j, na, nb = _q7_pairs(f)
+    j = j[
+        ((j.s_n_n_name == na) & (j.c_n_n_name == nb))
+        | ((j.s_n_n_name == nb) & (j.c_n_n_name == na))
+    ].copy()
+    j["l_year"] = pd.to_datetime(j.l_shipdate).dt.year
+    j["volume"] = rev(j)
+    w = (
+        j.groupby(["s_n_n_name", "c_n_n_name", "l_year"])
+        .volume.sum()
+        .rename("revenue")
+        .reset_index()
+        .sort_values(["s_n_n_name", "c_n_n_name", "l_year"])
+        .reset_index(drop=True)
+    )
+    res = run_sql(ctx, q("q7", {"FRANCE": na, "GERMANY": nb}))
+    cmp(res, w)
+
+
+def test_q8(env):
+    ctx, f = env
+    j = (
+        f["part"]
+        .merge(f["lineitem"], left_on="p_partkey", right_on="l_partkey")
+        .merge(f["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+        .merge(f["orders"], left_on="l_orderkey", right_on="o_orderkey")
+        .merge(f["customer"], left_on="o_custkey", right_on="c_custkey")
+        .merge(
+            f["nation"].add_prefix("c_n_"),
+            left_on="c_nationkey",
+            right_on="c_n_n_nationkey",
+        )
+        .merge(
+            f["nation"].add_prefix("s_n_"),
+            left_on="s_nationkey",
+            right_on="s_n_n_nationkey",
+        )
+        .merge(f["region"], left_on="c_n_n_regionkey", right_on="r_regionkey")
+    )
+    # pick a type that appears in AMERICA-region orders in the window
+    j = j[
+        (j.r_name == "AMERICA")
+        & (j.o_orderdate >= D(1995, 1, 1))
+        & (j.o_orderdate <= D(1996, 12, 31))
+    ]
+    if len(j) == 0:
+        pytest.skip("no AMERICA trade at this scale")
+    ptype = j.p_type.value_counts().index[0]
+    j = j[j.p_type == ptype].copy()
+    nat = j.s_n_n_name.value_counts().index[0]
+    j["o_year"] = pd.to_datetime(j.o_orderdate).dt.year
+    j["volume"] = rev(j)
+    j["nat_vol"] = np.where(j.s_n_n_name == nat, j.volume, 0.0)
+    g = j.groupby("o_year").agg(nv=("nat_vol", "sum"), v=("volume", "sum"))
+    w = (g.nv / g.v).rename("mkt_share").reset_index().sort_values("o_year")
+    res = run_sql(
+        ctx, q("q8", {"BRAZIL": nat, "ECONOMY ANODIZED STEEL": ptype})
+    )
+    cmp(res, w.reset_index(drop=True))
+
+
+def test_q9(env):
+    ctx, f = env
+    j = (
+        f["part"][f["part"].p_name.str.contains("green")]
+        .merge(f["lineitem"], left_on="p_partkey", right_on="l_partkey")
+        .merge(f["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+        .merge(
+            f["partsupp"],
+            left_on=["l_partkey", "l_suppkey"],
+            right_on=["ps_partkey", "ps_suppkey"],
+        )
+        .merge(f["orders"], left_on="l_orderkey", right_on="o_orderkey")
+        .merge(f["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    ).copy()
+    j["o_year"] = pd.to_datetime(j.o_orderdate).dt.year
+    j["amount"] = rev(j) - j.ps_supplycost * j.l_quantity
+    w = (
+        j.groupby(["n_name", "o_year"])
+        .amount.sum()
+        .rename("sum_profit")
+        .reset_index()
+        .sort_values(["n_name", "o_year"], ascending=[True, False])
+        .reset_index(drop=True)
+    )
+    res = run_sql(ctx, q("q9"))
+    cmp(res, w)
+
+
+def test_q10(env):
+    ctx, f = env
+    j = (
+        f["customer"]
+        .merge(f["orders"], left_on="c_custkey", right_on="o_custkey")
+        .merge(f["lineitem"], left_on="o_orderkey", right_on="l_orderkey")
+        .merge(f["nation"], left_on="c_nationkey", right_on="n_nationkey")
+    )
+    j = j[
+        (j.o_orderdate >= D(1993, 10, 1))
+        & (j.o_orderdate < D(1994, 1, 1))
+        & (j.l_returnflag == "R")
+    ].copy()
+    j["revenue"] = rev(j)
+    w = (
+        j.groupby(
+            ["c_custkey", "c_name", "c_acctbal", "c_phone", "n_name",
+             "c_address", "c_comment"]
+        )
+        .revenue.sum()
+        .reset_index()
+        .sort_values("revenue", ascending=False)
+        .head(20)[
+            ["c_custkey", "c_name", "revenue", "c_acctbal", "n_name",
+             "c_address", "c_phone", "c_comment"]
+        ]
+        .reset_index(drop=True)
+    )
+    res = run_sql(ctx, q("q10"))
+    cmp(res, w)
+
+
+def test_q11(env):
+    ctx, f = env
+    j = (
+        f["partsupp"]
+        .merge(f["supplier"], left_on="ps_suppkey", right_on="s_suppkey")
+        .merge(f["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    )
+    nat = j.n_name.value_counts().index[0]
+    jj = j[j.n_name == nat].copy()
+    jj["value"] = jj.ps_supplycost * jj.ps_availqty
+    g = jj.groupby("ps_partkey")["value"].sum()
+    w = (
+        g[g > jj["value"].sum() * 0.0001]
+        .sort_values(ascending=False)
+        .rename("value")
+        .reset_index()
+    )
+    res = run_sql(ctx, q("q11", {"GERMANY": nat}))
+    cmp(res, w)
+
+
+def test_q12(env):
+    ctx, f = env
+    j = f["orders"].merge(
+        f["lineitem"], left_on="o_orderkey", right_on="l_orderkey"
+    )
+    j = j[
+        j.l_shipmode.isin(["MAIL", "SHIP"])
+        & (j.l_commitdate < j.l_receiptdate)
+        & (j.l_shipdate < j.l_commitdate)
+        & (j.l_receiptdate >= D(1994, 1, 1))
+        & (j.l_receiptdate < D(1995, 1, 1))
+    ]
+    hi = j.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
+    w = (
+        j.assign(h=hi.astype(int), lo=(~hi).astype(int))
+        .groupby("l_shipmode")[["h", "lo"]]
+        .sum()
+        .reset_index()
+        .sort_values("l_shipmode")
+        .reset_index(drop=True)
+    )
+    res = run_sql(ctx, q("q12"))
+    cmp(res, w)
+
+
+def test_q13(env):
+    ctx, f = env
+    o = f["orders"][
+        ~f["orders"].o_comment.str.contains("special.*requests", regex=True)
+    ]
+    m = f["customer"].merge(
+        o, left_on="c_custkey", right_on="o_custkey", how="left"
+    )
+    cc = m.groupby("c_custkey").o_orderkey.count().rename("c_count")
+    w = (
+        cc.reset_index()
+        .groupby("c_count")
+        .size()
+        .rename("custdist")
+        .reset_index()
+        .sort_values(["custdist", "c_count"], ascending=[False, False])
+        [["c_count", "custdist"]]
+        .reset_index(drop=True)
+    )
+    res = run_sql(ctx, q("q13"))
+    cmp(res, w)
+
+
+def test_q14(env):
+    ctx, f = env
+    j = f["lineitem"].merge(f["part"], left_on="l_partkey", right_on="p_partkey")
+    j = j[(j.l_shipdate >= D(1995, 9, 1)) & (j.l_shipdate < D(1995, 10, 1))]
+    v = rev(j)
+    promo = v[j.p_type.str.startswith("PROMO")].sum()
+    w = pd.DataFrame({"promo_revenue": [100.0 * promo / v.sum()]})
+    res = run_sql(ctx, q("q14"))
+    cmp(res, w)
+
+
+def test_q15(env):
+    ctx, f = env
+    li = f["lineitem"]
+    win = li[(li.l_shipdate >= D(1996, 1, 1)) & (li.l_shipdate < D(1996, 4, 1))]
+    g = (win.l_extendedprice * (1 - win.l_discount)).groupby(win.l_suppkey).sum()
+    mx = g.max()
+    top = g[g == mx].reset_index()
+    top.columns = ["s_suppkey", "total_revenue"]
+    w = (
+        f["supplier"]
+        .merge(top, on="s_suppkey")[
+            ["s_suppkey", "s_name", "s_address", "s_phone", "total_revenue"]
+        ]
+        .sort_values("s_suppkey")
+        .reset_index(drop=True)
+    )
+    res = run_sql(ctx, q("q15"))
+    cmp(res, w)
+
+
+def test_q16(env):
+    ctx, f = env
+    j = f["partsupp"].merge(
+        f["part"], left_on="ps_partkey", right_on="p_partkey"
+    )
+    j = j[
+        (j.p_brand != "Brand#45")
+        & ~j.p_type.str.startswith("MEDIUM POLISHED")
+        & j.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])
+    ]
+    bad = f["supplier"][
+        f["supplier"].s_comment.str.contains("Customer.*Complaints", regex=True)
+    ].s_suppkey
+    j = j[~j.ps_suppkey.isin(bad)]
+    w = (
+        j.groupby(["p_brand", "p_type", "p_size"])
+        .ps_suppkey.nunique()
+        .rename("supplier_cnt")
+        .reset_index()
+        .sort_values(
+            ["supplier_cnt", "p_brand", "p_type", "p_size"],
+            ascending=[False, True, True, True],
+        )
+        .reset_index(drop=True)
+    )
+    res = run_sql(ctx, q("q16"))
+    cmp(res, w)
+
+
+def test_q17(env):
+    ctx, f = env
+    li, pt = f["lineitem"], f["part"]
+    j = li.merge(pt, left_on="l_partkey", right_on="p_partkey")
+    combos = (
+        j.groupby(["p_brand", "p_container"]).size().sort_values(ascending=False)
+    )
+    brand, cont = combos.index[0]
+    j = j[(j.p_brand == brand) & (j.p_container == cont)]
+    avg_q = li.groupby("l_partkey").l_quantity.mean()
+    j = j[j.l_quantity < 0.2 * j.l_partkey.map(avg_q)]
+    w = pd.DataFrame({"avg_yearly": [j.l_extendedprice.sum() / 7.0]})
+    res = run_sql(ctx, q("q17", {"Brand#23": brand, "MED BOX": cont}))
+    cmp(res, w)
+
+
+def test_q18(env):
+    ctx, f = env
+    li = f["lineitem"]
+    per_order = li.groupby("l_orderkey").l_quantity.sum()
+    thr = float(np.floor(per_order.quantile(0.95)))
+    keys = per_order[per_order > thr].index
+    assert len(keys) > 0
+    j = (
+        f["customer"]
+        .merge(f["orders"], left_on="c_custkey", right_on="o_custkey")
+        .merge(li, left_on="o_orderkey", right_on="l_orderkey")
+    )
+    j = j[j.o_orderkey.isin(keys)]
+    w = (
+        j.groupby(
+            ["c_name", "c_custkey", "o_orderkey", "o_orderdate", "o_totalprice"]
+        )
+        .l_quantity.sum()
+        .reset_index()
+        .sort_values(
+            ["o_totalprice", "o_orderdate"], ascending=[False, True]
+        )
+        .head(100)
+        .reset_index(drop=True)
+    )
+    res = run_sql(ctx, q("q18", {"> 300": f"> {int(thr)}"}))
+    cmp(res, w)
+
+
+def test_q19(env):
+    ctx, f = env
+    j = f["lineitem"].merge(f["part"], left_on="l_partkey", right_on="p_partkey")
+    base = j.l_shipmode.isin(["AIR", "AIR REG"]) & (
+        j.l_shipinstruct == "DELIVER IN PERSON"
+    )
+
+    def arm(containers, qlo, qhi, slo, shi, spec_brand):
+        m = (
+            base
+            & j.p_container.isin(containers)
+            & (j.l_quantity >= qlo) & (j.l_quantity <= qhi)
+            & (j.p_size >= slo) & (j.p_size <= shi)
+        )
+        # spec brands select nothing at SF=0.002 — substitute a brand that
+        # actually appears in this arm's remaining row set
+        brands = j.p_brand[m].value_counts()
+        brand = brands.index[0] if len(brands) else spec_brand
+        return m & (j.p_brand == brand), brand
+
+    m1, b1 = arm(["SM CASE", "SM BOX", "SM PACK", "SM PKG"], 1, 11, 1, 5,
+                 "Brand#12")
+    m2, b2 = arm(["MED BAG", "MED BOX", "MED PKG", "MED PACK"], 10, 20, 1, 10,
+                 "Brand#23")
+    m3, b3 = arm(["LG CASE", "LG BOX", "LG PACK", "LG PKG"], 20, 30, 1, 15,
+                 "Brand#34")
+    sel = rev(j)[m1 | m2 | m3]
+    assert len(sel) > 0
+    w = pd.DataFrame({"revenue": [sel.sum()]})
+    res = run_sql(
+        ctx, q("q19", {"Brand#12": b1, "Brand#23": b2, "Brand#34": b3})
+    )
+    cmp(res, w)
+
+
+def test_q20(env):
+    ctx, f = env
+    s, n, ps, pt, li = (
+        f["supplier"], f["nation"], f["partsupp"], f["part"], f["lineitem"],
+    )
+    nat = (
+        s.merge(n, left_on="s_nationkey", right_on="n_nationkey")
+        .n_name.value_counts()
+        .index[0]
+    )
+    prefix = pt.p_name.str[:3].value_counts().index[0]
+    parts = pt[pt.p_name.str.startswith(prefix)].p_partkey
+    win = li[(li.l_shipdate >= D(1994, 1, 1)) & (li.l_shipdate < D(1995, 1, 1))]
+    half = (
+        win.groupby(["l_partkey", "l_suppkey"]).l_quantity.sum() * 0.5
+    )
+    cand = ps[ps.ps_partkey.isin(parts)].copy()
+    key = list(zip(cand.ps_partkey, cand.ps_suppkey))
+    cand["thr"] = [half.get(k, np.nan) for k in key]
+    cand = cand[cand.ps_availqty > cand.thr]  # NaN > fails -> excluded
+    sel = (
+        s[s.s_suppkey.isin(cand.ps_suppkey)]
+        .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+    )
+    sel = sel[sel.n_name == nat]
+    w = (
+        sel[["s_name", "s_address"]]
+        .sort_values("s_name")
+        .reset_index(drop=True)
+    )
+    res = run_sql(ctx, q("q20", {"CANADA": nat, "'forest%'": f"'{prefix}%'"}))
+    cmp(res, w)
+
+
+def test_q21(env):
+    ctx, f = env
+    s, li, o, n = f["supplier"], f["lineitem"], f["orders"], f["nation"]
+    nat = (
+        s.merge(n, left_on="s_nationkey", right_on="n_nationkey")
+        .n_name.value_counts()
+        .index[0]
+    )
+    l1 = li.merge(s, left_on="l_suppkey", right_on="s_suppkey").merge(
+        o, left_on="l_orderkey", right_on="o_orderkey"
+    ).merge(n, left_on="s_nationkey", right_on="n_nationkey")
+    l1 = l1[
+        (l1.o_orderstatus == "F")
+        & (l1.l_receiptdate > l1.l_commitdate)
+        & (l1.n_name == nat)
+    ]
+    # exists: another supplier in same order
+    nsupp = li.groupby("l_orderkey").l_suppkey.nunique()
+    l1 = l1[l1.l_orderkey.map(nsupp) > 1]
+    # not exists: another supplier in same order that was ALSO late
+    late = li[li.l_receiptdate > li.l_commitdate]
+    nsupp_late = late.groupby("l_orderkey").l_suppkey.nunique()
+
+    def other_late(row):
+        nl = nsupp_late.get(row.l_orderkey, 0)
+        # suppliers (distinct) late in this order, excluding row's supplier
+        me_late = 1  # row itself is late
+        return (nl - me_late) > 0
+
+    l1 = l1[~l1.apply(other_late, axis=1)]
+    w = (
+        l1.groupby("s_name")
+        .size()
+        .rename("numwait")
+        .reset_index()
+        .sort_values(["numwait", "s_name"], ascending=[False, True])
+        .head(100)
+        .reset_index(drop=True)
+    )
+    res = run_sql(ctx, q("q21", {"SAUDI ARABIA": nat}))
+    cmp(res, w)
+
+
+def test_q22(env):
+    ctx, f = env
+    c, o = f["customer"], f["orders"]
+    # Prefer codes covering customers WITHOUT orders (so NOT EXISTS keeps
+    # rows); at this scale every customer may have orders — then both engine
+    # and oracle agree on the empty result and the anti-join machinery is
+    # covered by q16/q21 instead.
+    no_orders = c[~c.c_custkey.isin(o.o_custkey) & (c.c_acctbal > 0)]
+    base = no_orders if len(no_orders) else c
+    codes = list(base.c_phone.str[:2].value_counts().index[:7])
+    sel = c[c.c_phone.str[:2].isin(codes)]
+    avg_bal = sel[sel.c_acctbal > 0.0].c_acctbal.mean()
+    sel = sel[sel.c_acctbal > avg_bal]
+    sel = sel[~sel.c_custkey.isin(o.o_custkey)]
+    w = (
+        sel.groupby(sel.c_phone.str[:2])
+        .agg(numcust=("c_custkey", "count"), totacctbal=("c_acctbal", "sum"))
+        .rename_axis("cntrycode")
+        .reset_index()
+        .sort_values("cntrycode")
+        .reset_index(drop=True)
+    )
+    subst = {
+        "('13', '31', '23', '29', '30', '18', '17')": (
+            "(" + ", ".join(f"'{x}'" for x in codes) + ")"
+        ),
+    }
+    res = run_sql(ctx, q("q22", subst))
+    cmp(res, w)
