@@ -9,6 +9,11 @@ device; the two shuffle tiers consume it differently:
   takes and written as Arrow IPC (executor.shuffle);
 - on-pod ICI tier: rows are binned to equal-capacity buckets on device and
   exchanged with ``jax.lax.all_to_all`` (parallel.collective).
+
+Both tiers MUST route identically, so this module owns the one hash rule:
+key values are zeroed under their null masks first (SQL GROUP BY treats
+NULL as one group — its routing cannot depend on whatever garbage sits
+under the mask).
 """
 
 from __future__ import annotations
@@ -19,12 +24,31 @@ from ballista_tpu.columnar.batch import DeviceBatch
 from ballista_tpu.ops.hashing import hash_columns
 
 
+def partition_ids_for(
+    cols: list[jnp.ndarray],
+    nulls: list[jnp.ndarray | None],
+    valid: jnp.ndarray,
+    num_partitions: int,
+) -> jnp.ndarray:
+    """Per-row partition id in [0, num_partitions); invalid rows get
+    num_partitions (a drop bucket). Column values are zeroed under null so
+    every NULL key routes to the same partition."""
+    hashed = [
+        c if m is None else jnp.where(m, jnp.zeros((), dtype=c.dtype), c)
+        for c, m in zip(cols, nulls)
+    ]
+    h = hash_columns(hashed)
+    pid = (h % jnp.uint64(num_partitions)).astype(jnp.int32)
+    return jnp.where(valid, pid, num_partitions)
+
+
 def partition_ids(
     batch: DeviceBatch, key_idxs: list[int], num_partitions: int
 ) -> jnp.ndarray:
-    """Per-row partition id in [0, num_partitions); invalid rows get
-    num_partitions (a drop bucket)."""
-    cols = [batch.columns[i] for i in key_idxs]
-    h = hash_columns(cols)
-    pid = (h % jnp.uint64(num_partitions)).astype(jnp.int32)
-    return jnp.where(batch.valid, pid, num_partitions)
+    """DeviceBatch wrapper over ``partition_ids_for``."""
+    return partition_ids_for(
+        [batch.columns[i] for i in key_idxs],
+        [batch.nulls[i] for i in key_idxs],
+        batch.valid,
+        num_partitions,
+    )

@@ -1,0 +1,25 @@
+"""On-pod (ICI) parallel tier: mesh-aware shuffle exchange + stage programs.
+
+This package is the TPU-native replacement for the reference's network
+shuffle hot path (ballista/rust/core/src/execution_plans/
+shuffle_writer.rs:201-285 writing IPC files, shuffle_reader.rs:102-130
+fetching them over Flight): inside one pod the exchange is a
+``jax.lax.all_to_all`` over the ICI mesh inside a single jitted
+``shard_map`` program — no files, no Flight, no host round-trip.
+
+Layout:
+- ``mesh``: device mesh construction + host<->mesh batch movement
+- ``collective``: traceable bucket + all_to_all exchange kernels (must be
+  called inside ``shard_map``)
+- ``stage``: compiled mesh stage programs (repartitioned aggregate,
+  partitioned join) — the on-pod analogues of the reference's
+  hash-RepartitionExec stage boundaries (scheduler/src/planner.rs:133-157)
+"""
+
+from ballista_tpu.parallel.mesh import (  # noqa: F401
+    SHARD_AXIS,
+    make_mesh,
+    shard_batch,
+    unshard_batch,
+)
+from ballista_tpu.parallel.stage import MeshStageRunner  # noqa: F401

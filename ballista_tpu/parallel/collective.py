@@ -1,0 +1,105 @@
+"""Traceable shuffle-exchange kernels (call INSIDE ``shard_map``).
+
+The on-pod replacement for the reference's hash shuffle
+(shuffle_writer.rs:201-285 -> IPC files -> shuffle_reader.rs:102-130 over
+Flight): each device hash-bins its local rows into ``n_parts``
+equal-capacity buckets (one fused stable sort + scatter, static shapes),
+then one ``jax.lax.all_to_all`` over ICI delivers bucket *d* of every
+device to device *d*. Bucket overflow is detected on device and surfaced
+as a flag for the host to raise after the step (no data-dependent shapes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ballista_tpu.ops.partition import partition_ids_for
+from ballista_tpu.ops.perm import multi_key_perm
+
+
+def bucket_rows(
+    cols: tuple[jnp.ndarray, ...],
+    nulls: tuple[jnp.ndarray | None, ...],
+    valid: jnp.ndarray,
+    key_positions: tuple[int, ...],
+    n_parts: int,
+    bucket_cap: int,
+) -> tuple[tuple, tuple, jnp.ndarray, jnp.ndarray]:
+    """Scatter local rows into ``n_parts`` contiguous buckets of
+    ``bucket_cap`` slots each. Returns (cols, nulls, valid, overflow) with
+    row axis ``n_parts * bucket_cap``."""
+    key_cols = [cols[i] for i in key_positions]
+    key_nulls = [nulls[i] for i in key_positions]
+    pid = partition_ids_for(key_cols, key_nulls, valid, n_parts)
+    perm = multi_key_perm([(pid, False)])
+    pid_s = pid[perm]
+    starts = jnp.searchsorted(pid_s, jnp.arange(n_parts, dtype=pid_s.dtype))
+    cap = valid.shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    pid_c = jnp.clip(pid_s, 0, n_parts - 1)
+    rank = iota - starts[pid_c].astype(jnp.int32)
+    live = pid_s < n_parts
+    fits = live & (rank < bucket_cap)
+    overflow = jnp.any(live & (rank >= bucket_cap))
+    out_len = n_parts * bucket_cap
+    # rows that don't fit scatter to the drop slot out_len
+    slot = jnp.where(fits, pid_c * bucket_cap + rank, out_len)
+
+    def scatter(col, fill):
+        base = jnp.full((out_len,) + col.shape[1:], fill, dtype=col.dtype)
+        return base.at[slot].set(col[perm], mode="drop")
+
+    out_cols = tuple(scatter(c, 0) for c in cols)
+    out_nulls = tuple(
+        None if m is None else scatter(m, True) for m in nulls
+    )
+    out_valid = (
+        jnp.zeros(out_len, dtype=bool).at[slot].set(fits, mode="drop")
+    )
+    return out_cols, out_nulls, out_valid, overflow
+
+
+def all_to_all_rows(
+    cols: tuple[jnp.ndarray, ...],
+    nulls: tuple[jnp.ndarray | None, ...],
+    valid: jnp.ndarray,
+    axis_name: str,
+    n_parts: int,
+    bucket_cap: int,
+) -> tuple[tuple, tuple, jnp.ndarray]:
+    """Exchange bucketed rows over ICI: bucket d of every device lands on
+    device d. Row axis stays ``n_parts * bucket_cap`` (bucket b of the
+    result = rows received from peer b)."""
+
+    def xc(col):
+        x = col.reshape((n_parts, bucket_cap) + col.shape[1:])
+        y = jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0)
+        return y.reshape((n_parts * bucket_cap,) + col.shape[1:])
+
+    return (
+        tuple(xc(c) for c in cols),
+        tuple(None if m is None else xc(m) for m in nulls),
+        xc(valid),
+    )
+
+
+def exchange_by_key(
+    batch_cols: tuple[jnp.ndarray, ...],
+    batch_nulls: tuple[jnp.ndarray | None, ...],
+    valid: jnp.ndarray,
+    key_positions: tuple[int, ...],
+    axis_name: str,
+    n_parts: int,
+    bucket_cap: int,
+) -> tuple[tuple, tuple, jnp.ndarray, jnp.ndarray]:
+    """bucket_rows + all_to_all_rows: after this, every live row sits on
+    the device owning hash(key) % n_parts. Returns (cols, nulls, valid,
+    overflow)."""
+    cols, nulls, v, overflow = bucket_rows(
+        batch_cols, batch_nulls, valid, key_positions, n_parts, bucket_cap
+    )
+    cols, nulls, v = all_to_all_rows(
+        cols, nulls, v, axis_name, n_parts, bucket_cap
+    )
+    return cols, nulls, v, overflow
