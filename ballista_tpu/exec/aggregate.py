@@ -262,12 +262,13 @@ class HashAggregateExec(ExecutionPlan):
         n_groups: int,
         cap: int,
         from_state: bool,
+        ctx: TaskContext | None = None,
     ) -> DeviceBatch:
         """One jitted group_aggregate pass -> state-shaped DeviceBatch.
         ``from_state``: value columns are already state slots (merge pass);
         otherwise they come from the pre-projection via each slot's ``src``
-        (first partial pass). The overflow flag is checked host-side after
-        the jitted call."""
+        (first partial pass). The overflow flag is deferred to the task
+        boundary (one batched fetch) instead of a per-pass device sync."""
         # group_aggregate host-composes cached sort passes + a jitted
         # finisher — do NOT wrap it in another jit (that would re-inline the
         # sorts into one slow-compiling program).
@@ -289,7 +290,14 @@ class HashAggregateExec(ExecutionPlan):
             key_cols, key_nulls, batch.valid, val_cols, val_nulls,
             list(ops), cap,
         )
-        res.check_overflow()
+        if ctx is not None:
+            ctx.defer_check(
+                res.overflow,
+                "aggregate exceeded group capacity; raise "
+                "ballista.tpu.agg_capacity",
+            )
+        else:
+            res.check_overflow()
         state_schema = batch.schema if from_state else self._schema
         dtypes = tuple(f.dtype.value for f in state_schema)
         out = _state_batch_program(dtypes)(res, state_schema)
@@ -340,7 +348,9 @@ class HashAggregateExec(ExecutionPlan):
         for b in pre.execute(partition, ctx):
             with self.metrics.time("agg_time"):
                 partials.append(
-                    self._run_group_agg(b, ops, n_groups, cap, from_state=False)
+                    self._run_group_agg(
+                        b, ops, n_groups, cap, from_state=False, ctx=ctx
+                    )
                 )
             self.metrics.add("input_batches")
         if not partials:
@@ -352,7 +362,9 @@ class HashAggregateExec(ExecutionPlan):
         # shuffle volume
         merged = concat_batches(partials)
         merge_ops = [s.op.merge_op for s in self.spec.slots]
-        yield self._run_group_agg(merged, merge_ops, n_groups, cap, from_state=True)
+        yield self._run_group_agg(
+            merged, merge_ops, n_groups, cap, from_state=True, ctx=ctx
+        )
 
     def _scalar_state(self, b: DeviceBatch) -> DeviceBatch:
         val_cols, val_nulls = [], []
@@ -412,7 +424,7 @@ class HashAggregateExec(ExecutionPlan):
         merged = concat_batches(states) if len(states) > 1 else states[0]
         with self.metrics.time("merge_time"):
             state = self._run_group_agg(
-                merged, merge_ops, n_groups, cap, from_state=True
+                merged, merge_ops, n_groups, cap, from_state=True, ctx=ctx
             )
         yield self._finalize(state, n_groups)
 

@@ -42,6 +42,28 @@ class TaskContext:
     session_id: str = ""
     job_id: str = ""
     work_dir: str = ""
+    # Deferred on-device error flags (bool scalars). Fetching a scalar costs
+    # a full host round-trip (~100ms over a tunnelled TPU), so capacity
+    # checks enqueue here and the task boundary fetches them all in ONE
+    # device_get (raise_deferred) instead of one sync per operator.
+    deferred_checks: list = dataclasses.field(default_factory=list)
+
+    def defer_check(self, flag, message: str) -> None:
+        self.deferred_checks.append((flag, message))
+
+    def raise_deferred(self) -> None:
+        if not self.deferred_checks:
+            return
+        import jax
+
+        from ballista_tpu.errors import ExecutionError
+
+        flags = jax.device_get([f for f, _ in self.deferred_checks])
+        msgs = [m for _, m in self.deferred_checks]
+        self.deferred_checks.clear()
+        fired = [m for f, m in zip(flags, msgs) if bool(f)]
+        if fired:
+            raise ExecutionError("; ".join(dict.fromkeys(fired)))
 
 
 class Metrics:
@@ -58,7 +80,12 @@ class Metrics:
         return _Timer(self, name)
 
     def summary(self) -> dict[str, float]:
-        out: dict[str, float] = dict(self.counters)
+        # counters may hold device scalars (recorded without syncing on the
+        # hot path); resolve them here, at report time
+        out: dict[str, float] = {
+            k: v if isinstance(v, (int, float)) else int(v)
+            for k, v in self.counters.items()
+        }
         out.update({k: round(v, 6) for k, v in self.timers.items()})
         return out
 

@@ -220,6 +220,9 @@ class DataFrame:
                 rb = batch_to_arrow(b)
                 if rb.num_rows:
                     record_batches.append(rb)
+        # capacity checks deferred during execution fire here, in one
+        # batched device fetch
+        ctx.raise_deferred()
         if not record_batches:
             from ballista_tpu.columnar.arrow_interop import schema_to_arrow
 

@@ -187,9 +187,9 @@ class HashJoinExec(ExecutionPlan):
             bb, pb = self._unify_key_dicts(build_batch, b, right_keys, left_keys)
             if bt is None or bb is not build_batch:
                 # rebuild only when dictionary remapping changed the build
+                # (overflow is checked inside _probe_or_expand's flag fetch)
                 with self.metrics.time("build_time"):
                     bt = build_side(bb, right_keys)
-                bt.check_overflow()
                 build_batch = bb
             out = self._probe_or_expand(bt, pb, left_keys, kind)
             self.metrics.add("output_batches")
@@ -213,7 +213,8 @@ class HashJoinExec(ExecutionPlan):
         bb, pb = self._unify_key_dicts(right_batch, first, right_keys, left_keys)
         with self.metrics.time("build_time"):
             bt = build_side(bb, right_keys)
-        if bool(bt.has_dups) or bool(bt.run_overflow):
+        bt_dups, bt_ovf = bt.flags()
+        if bt_dups or bt_ovf:
             # Right side can't serve as a unique build (dups, or a hash-mode
             # collision run past the probe window). Deterministic across
             # partitions: emit all output from partition 0, nothing
@@ -227,7 +228,8 @@ class HashJoinExec(ExecutionPlan):
             )
             with self.metrics.time("build_time"):
                 lbt = build_side(lb, left_keys)
-            if not bool(lbt.has_dups) and not bool(lbt.run_overflow):
+            lbt_dups, lbt_ovf = lbt.flags()
+            if not lbt_dups and not lbt_ovf:
                 # flip: build (unique) left, probe the collected right
                 joined = self._probe_with_filter(
                     lbt, rb, right_keys, JoinSide.INNER
@@ -240,7 +242,7 @@ class HashJoinExec(ExecutionPlan):
                 return
             # both sides duplicated: m:n expansion, building whichever side
             # has no collision overflow (expansion needs countable runs)
-            if bool(bt.run_overflow) and not bool(lbt.run_overflow):
+            if bt_ovf and not lbt_ovf:
                 joined = self._expand_with_filter(
                     lbt, rb, right_keys, JoinSide.INNER
                 )
@@ -283,7 +285,10 @@ class HashJoinExec(ExecutionPlan):
         """Unique build -> fixed-capacity probe; duplicated build -> m:n
         expansion (ref: DataFusion HashJoinExec m:n semantics, serde
         physical_plan mod.rs:438-523)."""
-        if not bool(bt.has_dups):
+        dups, overflow = bt.flags()
+        if overflow:
+            bt.check_overflow()
+        if not dups:
             return self._probe_with_filter(bt, probe, probe_keys, kind)
         return self._expand_with_filter(bt, probe, probe_keys, kind)
 

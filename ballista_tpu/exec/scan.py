@@ -78,7 +78,9 @@ class MemoryScanExec(ExecutionPlan):
             return
         chunk = t.slice(start, stop - start)
         for b in table_from_arrow(chunk, self.batch_rows):
-            self.metrics.add("output_rows", b.num_rows())
+            # device scalar — resolved lazily at metrics report time (an
+            # int() here would cost a host sync per batch)
+            self.metrics.add("output_rows", b.count_valid())
             yield b
 
 

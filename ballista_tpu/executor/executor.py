@@ -70,6 +70,7 @@ class Executor:
             work_dir=self.work_dir,
         )
         out = plan.execute_shuffle_write(task.task_id.partition_id, ctx)
+        ctx.raise_deferred()
         self.metrics_collector.record_stage(
             task.task_id.job_id, task.task_id.stage_id,
             task.task_id.partition_id, plan,

@@ -142,16 +142,28 @@ class BuildTable:
             has_dups=has_dups, run_overflow=run_overflow,
         )
 
+    def flags(self) -> tuple[bool, bool]:
+        """(has_dups, run_overflow) fetched in ONE device round-trip and
+        cached (each scalar sync costs ~100ms over a tunnelled TPU)."""
+        cached = getattr(self, "_flags_cache", None)
+        if cached is None:
+            d, o = jax.device_get((self.has_dups, self.run_overflow))
+            cached = (bool(d), bool(o))
+            object.__setattr__(self, "_flags_cache", cached)
+        return cached
+
     def check_unique(self) -> None:
-        if bool(self.has_dups):
+        dups, overflow = self.flags()
+        if dups:
             raise ExecutionError(
                 "join build side has duplicate keys; only unique-build "
                 "(PK-FK) joins are supported on device in this version"
             )
-        self.check_overflow()
+        if overflow:
+            self.check_overflow()
 
     def check_overflow(self) -> None:
-        if bool(self.run_overflow):
+        if self.flags()[1]:
             raise ExecutionError(
                 "join build side has a packed-hash collision run longer "
                 f"than {COLLISION_WINDOW}; use an integer join key or "

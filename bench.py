@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""TPC-H benchmark harness (ref: benchmarks/src/bin/tpch.rs:245-249 —
+`tpch benchmark`, N iterations per query, JSON summary).
+
+Runs the headline queries (BASELINE.md: q1/q3/q5/q6/q18) on the default
+JAX backend (the TPU when tunnelled), with a cold (compile) pass and warm
+iterations, then measures the same queries on the CPU backend in a
+subprocess to form the BASELINE.md x5 denominator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "queries/sec", "vs_baseline": N}
+where value = warm throughput over the headline set on this backend and
+vs_baseline = speedup vs the CPU-executor run (>1 means the device is
+faster; BASELINE.md target is >=5). Detailed per-query timings go to
+BENCH_DETAIL.json and stderr.
+
+Env knobs: BENCH_SF (default 0.1), BENCH_ITERS (default 3),
+BENCH_QUERIES (comma list, default q1,q3,q5,q6,q18), BENCH_SKIP_CPU=1.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+HERE = pathlib.Path(__file__).resolve().parent
+QDIR = HERE / "benchmarks" / "queries"
+
+SF = float(os.environ.get("BENCH_SF", "0.1"))
+ITERS = int(os.environ.get("BENCH_ITERS", "3"))
+QUERIES = os.environ.get("BENCH_QUERIES", "q1,q3,q5,q6,q18").split(",")
+
+
+def run_suite() -> dict:
+    """Run the query set in-process on the current JAX backend."""
+    sys.path.insert(0, str(HERE))
+    import jax
+
+    from ballista_tpu.exec.context import TpuContext
+    from ballista_tpu.tpch import gen_all
+
+    backend = jax.devices()[0].platform
+    t0 = time.time()
+    data = gen_all(scale=SF)
+    gen_s = time.time() - t0
+    ctx = TpuContext()
+    rows = {}
+    for name, t in data.items():
+        ctx.register_table(name, t)
+        rows[name] = t.num_rows
+
+    out = {
+        "backend": backend,
+        "sf": SF,
+        "gen_seconds": round(gen_s, 2),
+        "table_rows": rows,
+        "queries": {},
+    }
+    for qn in QUERIES:
+        sql = (QDIR / f"{qn}.sql").read_text()
+        t0 = time.time()
+        res = ctx.sql(sql).collect()
+        cold = time.time() - t0
+        warms = []
+        for _ in range(ITERS):
+            t0 = time.time()
+            res = ctx.sql(sql).collect()
+            warms.append(time.time() - t0)
+        out["queries"][qn] = {
+            "cold_s": round(cold, 4),
+            "warm_s": [round(w, 4) for w in warms],
+            "warm_best_s": round(min(warms), 4),
+            "rows": res.num_rows,
+            "lineitem_rows_per_s": int(rows["lineitem"] / min(warms)),
+        }
+    out["warm_total_s"] = round(
+        sum(q["warm_best_s"] for q in out["queries"].values()), 4
+    )
+    out["queries_per_s"] = round(len(QUERIES) / out["warm_total_s"], 4)
+    return out
+
+
+def main() -> None:
+    if os.environ.get("BENCH_CHILD"):
+        print(json.dumps(run_suite()))
+        return
+
+    device_run = run_suite()
+
+    cpu_run = None
+    if not os.environ.get("BENCH_SKIP_CPU"):
+        env = {
+            k: v
+            for k, v in os.environ.items()
+            if not k.startswith(("PALLAS_AXON", "AXON"))
+        }
+        env.update(
+            {
+                "BENCH_CHILD": "1",
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": str(HERE),
+                "BENCH_SF": str(SF),
+                "BENCH_ITERS": str(max(1, ITERS - 2)),
+                "BENCH_QUERIES": ",".join(QUERIES),
+            }
+        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, str(HERE / "bench.py")],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=3600,
+            )
+            if proc.returncode == 0:
+                cpu_run = json.loads(proc.stdout.strip().splitlines()[-1])
+            else:
+                print(proc.stderr[-2000:], file=sys.stderr)
+        except Exception as e:  # CPU baseline is best-effort
+            print(f"cpu baseline failed: {e}", file=sys.stderr)
+
+    detail = {"device": device_run, "cpu": cpu_run}
+    (HERE / "BENCH_DETAIL.json").write_text(json.dumps(detail, indent=2))
+    print(json.dumps(detail, indent=2), file=sys.stderr)
+
+    vs = 0.0
+    if cpu_run is not None:
+        # speedup on identical warm work: cpu_total / device_total
+        cpu_total = sum(q["warm_best_s"] for q in cpu_run["queries"].values())
+        vs = round(cpu_total / device_run["warm_total_s"], 3)
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"tpch_sf{SF}_warm_throughput_"
+                    + "_".join(QUERIES)
+                    + f"_{device_run['backend']}"
+                ),
+                "value": device_run["queries_per_s"],
+                "unit": "queries/sec",
+                "vs_baseline": vs,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
