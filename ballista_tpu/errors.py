@@ -45,3 +45,16 @@ class ConfigError(BallistaError):
 
 class ExecutionError(BallistaError):
     """Runtime failure while executing a physical plan."""
+
+
+class CapacityError(ExecutionError):
+    """A static device capacity (aggregate groups, join buckets) was
+    exceeded. ``required`` carries the exact size needed when known (the
+    aggregate kernel computes the true group count even on overflow), so
+    callers can retry with an adequately-grown capacity instead of failing
+    (adaptive sizing; the fixed-capacity failure mode is a TPU-only concern
+    with no reference counterpart)."""
+
+    def __init__(self, message: str, required: int = 0):
+        super().__init__(message)
+        self.required = int(required)

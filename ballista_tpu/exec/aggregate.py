@@ -158,6 +158,56 @@ def _agg_arg_exprs(agg_exprs: list[L.Expr]) -> list[L.Expr]:
     return list(seen.values())
 
 
+def finalize_state(
+    state: DeviceBatch, spec: AggSpec, out_schema: Schema
+) -> DeviceBatch:
+    """Merged state batch (group keys ++ slot values, positional slot
+    order) -> final output batch: AVG divides its SUM/COUNT slots, others
+    pass through with the output dtype. Shared by the local final aggregate
+    and the mesh (shard_map) aggregate, whose state layouts match."""
+    n_groups = len(spec.group_names)
+    cols = list(state.columns[:n_groups])
+    nulls = list(state.nulls[:n_groups])
+    dicts = {
+        k: v
+        for k, v in state.dictionaries.items()
+        if any(f.name == k for f in out_schema.fields[:n_groups])
+    }
+    for name, dtype, idxs, kind in spec.finals:
+        if kind == "avg":
+            s = state.columns[n_groups + idxs[0]]
+            c = state.columns[n_groups + idxs[1]]
+            vals = s.astype(jnp.float64) / jnp.maximum(c, 1).astype(
+                jnp.float64
+            )
+            nl = c == 0
+            base_null = state.nulls[n_groups + idxs[0]]
+            if base_null is not None:
+                nl = nl | base_null
+        else:
+            vals = state.columns[n_groups + idxs[0]]
+            nl = state.nulls[n_groups + idxs[0]]
+            if dtype == DataType.STRING:
+                # dictionary rides under the state slot's field name; re-key
+                # it to the final output name (MIN/MAX over a coded column)
+                slot_name = state.schema.fields[n_groups + idxs[0]].name
+                d = state.dictionaries.get(slot_name)
+                if d is not None:
+                    dicts[name] = d
+        want = dtype.to_np()
+        if vals.dtype != want:
+            vals = vals.astype(want)
+        cols.append(vals)
+        nulls.append(nl)
+    return DeviceBatch(
+        schema=out_schema,
+        columns=tuple(cols),
+        valid=state.valid,
+        nulls=tuple(nulls),
+        dictionaries=dicts,
+    )
+
+
 class HashAggregateExec(ExecutionPlan):
     """mode='partial' emits group keys + state columns per input partition;
     mode='final' merges partial outputs into final values (single output
@@ -253,6 +303,10 @@ class HashAggregateExec(ExecutionPlan):
 
     # -- execution -----------------------------------------------------------
     def _agg_capacity(self, ctx: TaskContext) -> int:
+        # adaptive retry override (set by run_with_capacity_retry after an
+        # overflow) wins over both the planned and the configured capacity
+        if ctx.agg_capacity_override:
+            return max(ctx.agg_capacity_override, self.capacity or 0)
         return self.capacity or ctx.config.agg_capacity()
 
     def _run_group_agg(
@@ -286,6 +340,10 @@ class HashAggregateExec(ExecutionPlan):
             else:
                 val_cols.append(batch.columns[s.src])
                 val_nulls.append(batch.nulls[s.src])
+        # group count can never exceed the batch's row capacity, so clamp the
+        # kernel capacity — keeps small batches cheap even when the session
+        # capacity was grown for a big merge
+        cap = min(cap, max(batch.capacity, 16))
         res = group_aggregate(
             key_cols, key_nulls, batch.valid, val_cols, val_nulls,
             list(ops), cap,
@@ -295,25 +353,36 @@ class HashAggregateExec(ExecutionPlan):
                 res.overflow,
                 "aggregate exceeded group capacity; raise "
                 "ballista.tpu.agg_capacity",
+                required=res.n_groups,
             )
         else:
             res.check_overflow()
         state_schema = batch.schema if from_state else self._schema
         dtypes = tuple(f.dtype.value for f in state_schema)
         out = _state_batch_program(dtypes)(res, state_schema)
+        dicts = {
+            k: v
+            for k, v in batch.dictionaries.items()
+            if any(
+                f.name == k and f.dtype == DataType.STRING
+                for f in state_schema
+            )
+        }
+        if not from_state:
+            # STRING value slots (MIN/MAX over a coded column) carry their
+            # source column's dictionary under the slot's renamed field
+            for j, s in enumerate(self.spec.slots):
+                f = state_schema.fields[n_groups + j]
+                if f.dtype == DataType.STRING and s.src is not None:
+                    d = batch.dictionaries.get(batch.schema.fields[s.src].name)
+                    if d is not None:
+                        dicts[f.name] = d
         return DeviceBatch(
             schema=out.schema,
             columns=out.columns,
             valid=out.valid,
             nulls=out.nulls,
-            dictionaries={
-                k: v
-                for k, v in batch.dictionaries.items()
-                if any(
-                    f.name == k and f.dtype == DataType.STRING
-                    for f in state_schema
-                )
-            },
+            dictionaries=dicts,
         )
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
@@ -429,34 +498,7 @@ class HashAggregateExec(ExecutionPlan):
         yield self._finalize(state, n_groups)
 
     def _finalize(self, state: DeviceBatch, n_groups: int) -> DeviceBatch:
-        cols = list(state.columns[:n_groups])
-        nulls = list(state.nulls[:n_groups])
-        for name, dtype, idxs, kind in self.spec.finals:
-            if kind == "avg":
-                s = state.columns[n_groups + idxs[0]]
-                c = state.columns[n_groups + idxs[1]]
-                vals = s.astype(jnp.float64) / jnp.maximum(c, 1).astype(
-                    jnp.float64
-                )
-                nl = c == 0
-                base_null = state.nulls[n_groups + idxs[0]]
-                if base_null is not None:
-                    nl = nl | base_null
-            else:
-                vals = state.columns[n_groups + idxs[0]]
-                nl = state.nulls[n_groups + idxs[0]]
-            want = dtype.to_np()
-            if vals.dtype != want:
-                vals = vals.astype(want)
-            cols.append(vals)
-            nulls.append(nl)
-        return DeviceBatch(
-            schema=self._schema,
-            columns=tuple(cols),
-            valid=state.valid,
-            nulls=tuple(nulls),
-            dictionaries=dict(state.dictionaries),
-        )
+        return finalize_state(state, self.spec, self._schema)
 
     def _finalize_scalar(self, outs, nulls) -> DeviceBatch:
         cap = 2048

@@ -42,28 +42,88 @@ class TaskContext:
     session_id: str = ""
     job_id: str = ""
     work_dir: str = ""
+    # Adaptive retry: when a previous attempt overflowed the aggregate group
+    # capacity, the retry runs with this override (wins over config/plan).
+    agg_capacity_override: int | None = None
     # Deferred on-device error flags (bool scalars). Fetching a scalar costs
     # a full host round-trip (~100ms over a tunnelled TPU), so capacity
     # checks enqueue here and the task boundary fetches them all in ONE
     # device_get (raise_deferred) instead of one sync per operator.
     deferred_checks: list = dataclasses.field(default_factory=list)
 
-    def defer_check(self, flag, message: str) -> None:
-        self.deferred_checks.append((flag, message))
+    def defer_check(self, flag, message: str, required=None) -> None:
+        """Queue a device bool ``flag``; if it fires at the task boundary the
+        task fails with ``message``. ``required`` (device int scalar) is the
+        capacity that would have sufficed — carried on the raised
+        CapacityError so the driver can retry adaptively."""
+        self.deferred_checks.append((flag, message, required))
 
     def raise_deferred(self) -> None:
         if not self.deferred_checks:
             return
         import jax
 
-        from ballista_tpu.errors import ExecutionError
+        from ballista_tpu.errors import CapacityError, ExecutionError
 
-        flags = jax.device_get([f for f, _ in self.deferred_checks])
-        msgs = [m for _, m in self.deferred_checks]
-        self.deferred_checks.clear()
-        fired = [m for f, m in zip(flags, msgs) if bool(f)]
-        if fired:
-            raise ExecutionError("; ".join(dict.fromkeys(fired)))
+        fetch = [
+            [f for f, _, _ in self.deferred_checks],
+            [
+                r if r is not None else 0
+                for _, _, r in self.deferred_checks
+            ],
+        ]
+        flags, reqs = jax.device_get(fetch)
+        checks = self.deferred_checks
+        self.deferred_checks = []
+        fired = [
+            (m, int(r))
+            for (f_, m, req), f, r in zip(checks, flags, reqs)
+            if bool(f)
+        ]
+        if not fired:
+            return
+        msg = "; ".join(dict.fromkeys(m for m, _ in fired))
+        required = max((r for _, r in fired), default=0)
+        if any(req is not None for (_, _, req), f in zip(checks, flags) if bool(f)):
+            raise CapacityError(msg, required=required)
+        raise ExecutionError(msg)
+
+
+# Hard ceiling for adaptive aggregate-capacity growth (groups). 8M groups x
+# ~8B per state column is comfortably within one chip's HBM; beyond it the
+# query needs a hash-repartitioned (multi-partition) aggregate instead.
+AGG_CAPACITY_HARD_MAX = 1 << 23
+
+
+def run_with_capacity_retry(config: BallistaConfig, fn, **ctx_fields):
+    """Centralized execution driver: build a TaskContext, run ``fn(ctx)``,
+    raise any deferred device checks, and on a CapacityError retry with the
+    capacity grown to fit (exact when the kernel reported the true group
+    count, else doubled). Every entry point that executes plans —
+    DataFrame.collect, the executor's shuffle-write task, the mesh runner —
+    routes through here so the deferred-check invariant cannot be missed
+    (a forgotten raise_deferred would silently truncate results)."""
+    from ballista_tpu.errors import CapacityError
+
+    override: int | None = None
+    while True:
+        ctx = TaskContext(
+            config=config, agg_capacity_override=override, **ctx_fields
+        )
+        try:
+            out = fn(ctx)
+            ctx.raise_deferred()
+            return out
+        except CapacityError as e:
+            ctx.deferred_checks.clear()
+            base = override or config.agg_capacity()
+            need = max(e.required + 1, base * 2)
+            new_cap = 1 << (need - 1).bit_length()
+            if new_cap > AGG_CAPACITY_HARD_MAX or (
+                override is not None and new_cap <= override
+            ):
+                raise
+            override = new_cap
 
 
 class Metrics:

@@ -24,6 +24,7 @@ from ballista_tpu.exec.base import (
     ExecutionPlan,
     TaskContext,
     UnknownPartitioning,
+    run_with_capacity_retry,
 )
 from ballista_tpu.exec.planner import PhysicalPlanner, TableProvider
 from ballista_tpu.exec.scan import CsvScanExec, MemoryScanExec, ParquetScanExec
@@ -48,6 +49,26 @@ class TpuContext(Catalog, TableProvider):
     def __init__(self, config: BallistaConfig | None = None):
         self.config = config or BallistaConfig()
         self.tables: dict[str, _Registered] = {}
+        self._mesh_runtime = None
+        self._mesh_checked = False
+
+    def mesh_runtime(self):
+        """The ICI collective-shuffle runtime, when this process sees >= 2
+        devices and ``ballista.tpu.collective_shuffle`` is on; None
+        otherwise (single chip -> the local operator tier is already
+        optimal). Created once; stage programs are cached across queries."""
+        if not self.config.collective_shuffle():
+            return None
+        if not self._mesh_checked:
+            self._mesh_checked = True
+            import jax
+
+            if len(jax.devices()) >= 2:
+                from ballista_tpu.exec.mesh import MeshRuntime
+                from ballista_tpu.parallel import make_mesh
+
+                self._mesh_runtime = MeshRuntime(make_mesh())
+        return self._mesh_runtime
 
     # -- registration (ref context.rs read_csv/read_parquet/register_*) ------
     def register_table(self, name: str, table: pa.Table) -> None:
@@ -119,7 +140,9 @@ class TpuContext(Catalog, TableProvider):
     def create_physical_plan(self, logical: LogicalPlan) -> ExecutionPlan:
         optimized = optimize(logical)
         partitions = self.config.default_shuffle_partitions()
-        return PhysicalPlanner(self, partitions).plan(optimized)
+        return PhysicalPlanner(
+            self, partitions, mesh_runtime=self.mesh_runtime()
+        ).plan(optimized)
 
     def sql(self, sql: str) -> "DataFrame":
         stmt = parse_sql(sql)
@@ -211,18 +234,22 @@ class DataFrame:
         if self._const is not None:
             return self._const
         phys = self.ctx.create_physical_plan(self.logical)
-        ctx = TaskContext(config=self.ctx.config)
         part = phys.output_partitioning()
         n = part.n if isinstance(part, UnknownPartitioning) else part.n
-        record_batches = []
-        for p in range(n):
-            for b in phys.execute(p, ctx):
-                rb = batch_to_arrow(b)
-                if rb.num_rows:
-                    record_batches.append(rb)
-        # capacity checks deferred during execution fire here, in one
-        # batched device fetch
-        ctx.raise_deferred()
+
+        def run(ctx: TaskContext) -> list:
+            out = []
+            for p in range(n):
+                for b in phys.execute(p, ctx):
+                    rb = batch_to_arrow(b)
+                    if rb.num_rows:
+                        out.append(rb)
+            return out
+
+        # run_with_capacity_retry raises deferred device checks in one
+        # batched fetch and, on aggregate-capacity overflow, re-runs the
+        # plan with the capacity grown to the reported group count
+        record_batches = run_with_capacity_retry(self.ctx.config, run)
         if not record_batches:
             from ballista_tpu.columnar.arrow_interop import schema_to_arrow
 

@@ -1,0 +1,307 @@
+"""Mesh-backed physical operators: the ICI collective-shuffle query path.
+
+When ``ballista.tpu.collective_shuffle`` is on and the process sees >= 2
+devices, the physical planner lowers repartitioned aggregates and
+partitioned joins to these operators instead of the serial
+partial -> CoalescePartitions -> final funnel. Each operator gathers its
+child batches, places them across the 1-D device mesh, and dispatches ONE
+compiled ``shard_map`` stage program (parallel/stage.py): local work +
+``jax.lax.all_to_all`` exchange over ICI — the on-pod replacement for the
+reference's file/Flight shuffle data plane (shuffle_writer.rs:142-292 <->
+shuffle_reader.rs:102-130; stage boundary rules planner.rs:133-157).
+
+Outputs stay mesh-sharded (single logical partition): a downstream mesh
+operator consumes them without any host hop (``is_row_sharded`` detects
+the invariant), and elementwise operators (Filter/Projection) preserve the
+sharding through XLA's propagation, so a q5/q18-shaped plan runs scan ->
+join -> join -> aggregate entirely on the mesh with exactly one
+host->device placement per base table.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax.numpy as jnp
+
+from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.datatypes import DataType, Field, Schema
+from ballista_tpu.errors import PlanError
+from ballista_tpu.exec.aggregate import (
+    AggSpec,
+    _agg_arg_exprs,
+    decompose_aggregates,
+    finalize_state,
+)
+from ballista_tpu.exec.base import (
+    ExecutionPlan,
+    TaskContext,
+    UnknownPartitioning,
+)
+from ballista_tpu.expr import logical as L
+from ballista_tpu.expr.physical import compile_expr
+from ballista_tpu.ops.aggregate import AggOp
+from ballista_tpu.ops.concat import concat_batches
+from ballista_tpu.ops.join import JoinSide
+from ballista_tpu.parallel import (
+    MeshStageRunner,
+    is_row_sharded,
+    shard_batch,
+)
+from ballista_tpu.plan.logical import JoinType
+
+
+class MeshRuntime:
+    """One mesh + stage-program cache per context (programs are compiled
+    per shape and reused across queries)."""
+
+    def __init__(self, mesh) -> None:
+        self.mesh = mesh
+        self.runner = MeshStageRunner(mesh)
+
+    def place(self, plan: ExecutionPlan, partition_hint, ctx) -> DeviceBatch:
+        """Collect every partition of ``plan`` and present it mesh-sharded.
+        A child that is itself a mesh operator hands over its sharded batch
+        unchanged."""
+        part = plan.output_partitioning()
+        batches = []
+        for p in range(part.n):
+            batches.extend(plan.execute(p, ctx))
+        if not batches:
+            return shard_batch(self.mesh, DeviceBatch.empty(plan.schema()))
+        if len(batches) == 1 and is_row_sharded(batches[0], self.mesh):
+            return batches[0]
+        merged = concat_batches(batches) if len(batches) > 1 else batches[0]
+        return shard_batch(self.mesh, merged)
+
+
+class MeshAggregateExec(ExecutionPlan):
+    """Repartitioned grouped aggregate as one mesh program: partial per
+    device -> all_to_all exchange of group states -> final merge, then the
+    standard finalizer (AVG division etc.). Single sharded output
+    partition. Replaces partial+coalesce+final when the mesh is active."""
+
+    def __init__(
+        self,
+        input: ExecutionPlan,
+        group_exprs: list[L.Expr],
+        agg_exprs: list[L.Expr],
+        runtime: MeshRuntime,
+        spec: AggSpec | None = None,
+    ) -> None:
+        super().__init__()
+        if not group_exprs:
+            raise PlanError("mesh aggregate requires group keys")
+        self.input = input
+        self.group_exprs = list(group_exprs)
+        self.agg_exprs = list(agg_exprs)
+        self.runtime = runtime
+        ins = input.schema()
+        self.spec = (
+            spec
+            if spec is not None
+            else decompose_aggregates(group_exprs, agg_exprs, ins)
+        )
+        self._pre_exprs = list(group_exprs) + _agg_arg_exprs(agg_exprs)
+        self._pre_schema = Schema(
+            [
+                Field(e.name(), e.data_type(ins), e.nullable(ins))
+                for e in self._pre_exprs
+            ]
+        )
+        ng = len(self.spec.group_names)
+        fields = list(self._pre_schema.fields[:ng])
+        for name, dtype, _, _ in self.spec.finals:
+            fields.append(Field(name, dtype, True))
+        self._schema = Schema(fields)
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def output_partitioning(self):
+        return UnknownPartitioning(1)
+
+    def describe(self) -> str:
+        g = ", ".join(self.spec.group_names)
+        a = ", ".join(s.name for s in self.spec.slots)
+        return f"MeshAggregateExec(ici-all_to_all): gby=[{g}], aggr=[{a}]"
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        from ballista_tpu.exec.pipeline import ProjectionExec
+
+        pre = ProjectionExec(self.input, self._pre_exprs)
+        batch = self.runtime.place(pre, None, ctx)
+        n_groups = len(self.spec.group_names)
+
+        # COUNT(*) slots aggregate a ones column appended past the schema
+        cols = list(batch.columns)
+        nulls = list(batch.nulls)
+        ones_idx = None
+        val_idxs, ops = [], []
+        for s in self.spec.slots:
+            if s.src is None:
+                if ones_idx is None:
+                    ones_idx = len(cols)
+                    cols.append(jnp.ones_like(batch.valid, dtype=jnp.int64))
+                    nulls.append(None)
+                val_idxs.append(ones_idx)
+            else:
+                val_idxs.append(s.src)
+            ops.append(s.op)
+        if ones_idx is not None:
+            ext_schema = Schema(
+                list(batch.schema.fields)
+                + [Field("__ones__", DataType.INT64, False)]
+            )
+            batch = DeviceBatch(
+                schema=ext_schema,
+                columns=tuple(cols),
+                valid=batch.valid,
+                nulls=tuple(nulls),
+                dictionaries=dict(batch.dictionaries),
+            )
+
+        with self.metrics.time("agg_time"):
+            state = self.runtime.runner.aggregate(
+                batch,
+                list(range(n_groups)),
+                val_idxs,
+                ops,
+                capacity=self._capacity(ctx),
+            )
+        yield finalize_state(state, self.spec, self._schema)
+
+    def _capacity(self, ctx: TaskContext) -> int:
+        if ctx.agg_capacity_override:
+            return ctx.agg_capacity_override
+        return ctx.config.agg_capacity()
+
+
+class MeshJoinExec(ExecutionPlan):
+    """PARTITIONED-mode hash join as one mesh program: both sides
+    all_to_all-exchanged by key hash, local build+probe (all pack modes,
+    m:n expansion) per device. INNER residual filters run inside the
+    program; LEFT/SEMI/ANTI are routed here only when filterless (the
+    planner enforces that)."""
+
+    def __init__(
+        self,
+        left: ExecutionPlan,
+        right: ExecutionPlan,
+        on: list[tuple[L.Expr, L.Expr]],
+        join_type: JoinType,
+        filter: L.Expr | None,
+        runtime: MeshRuntime,
+    ) -> None:
+        super().__init__()
+        self.left = left
+        self.right = right
+        self.on = list(on)
+        self.join_type = join_type
+        self.filter = filter
+        self.runtime = runtime
+        self._filter_fn = None
+        ls, rs = left.schema(), right.schema()
+        for a, b in self.on:
+            if not (isinstance(a, L.Column) and isinstance(b, L.Column)):
+                raise PlanError("join keys must be columns (planner projects)")
+        if join_type in (JoinType.SEMI, JoinType.ANTI):
+            self._schema = ls
+        elif join_type == JoinType.LEFT:
+            self._schema = ls.join(
+                Schema([Field(f.name, f.dtype, True) for f in rs])
+            )
+        elif join_type == JoinType.INNER:
+            self._schema = ls.join(rs)
+        else:
+            raise PlanError(f"mesh join does not support {join_type}")
+        if filter is not None and join_type != JoinType.INNER:
+            raise PlanError(
+                "mesh join residual filters are INNER-only; planner must "
+                "route filtered outer joins to the local tier"
+            )
+
+    _KIND = {
+        JoinType.INNER: JoinSide.INNER,
+        JoinType.LEFT: JoinSide.LEFT,
+        JoinType.SEMI: JoinSide.SEMI,
+        JoinType.ANTI: JoinSide.ANTI,
+    }
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.left, self.right]
+
+    def output_partitioning(self):
+        return UnknownPartitioning(1)
+
+    def describe(self) -> str:
+        on = ", ".join(f"{a.name()} = {b.name()}" for a, b in self.on)
+        f = f", filter={self.filter.name()}" if self.filter is not None else ""
+        return f"MeshJoinExec({self.join_type.value}, ici-all_to_all): on=[{on}]{f}"
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        from ballista_tpu.exec.joins import HashJoinExec
+
+        ls, rs = self.left.schema(), self.right.schema()
+        left_keys = [L.resolve_field_index(ls, a.cname) for a, _ in self.on]
+        right_keys = [L.resolve_field_index(rs, b.cname) for _, b in self.on]
+
+        lb = self.runtime.place(self.left, None, ctx)
+        rb = self.runtime.place(self.right, None, ctx)
+        # string join keys compare by code: unify dictionaries pre-exchange
+        lb, rb = HashJoinExec._unify_key_dicts(
+            self, lb, rb, left_keys, right_keys
+        )
+
+        filter_fn = None
+        if self.filter is not None:
+            filter_fn = self._residual_filter(lb.schema, rb.schema)
+
+        with self.metrics.time("join_time"):
+            out = self.runtime.runner.join(
+                lb,
+                rb,
+                left_keys,
+                right_keys,
+                self._KIND[self.join_type],
+                filter_fn=filter_fn,
+            )
+        # schema field names follow the plan schema (positional identity)
+        yield DeviceBatch(
+            schema=self._schema,
+            columns=out.columns,
+            valid=out.valid,
+            nulls=out.nulls,
+            dictionaries=self._rekey_dicts(out, self._schema),
+        )
+
+    def _residual_filter(self, l_schema: Schema, r_schema: Schema):
+        if self._filter_fn is None:
+            joined = l_schema.join(r_schema)
+            phys = compile_expr(self.filter, joined)
+
+            def fn(batch: DeviceBatch):
+                cv = phys.evaluate(batch)
+                passes = cv.values.astype(bool)
+                if cv.nulls is not None:
+                    passes = passes & ~cv.nulls
+                return passes
+
+            self._filter_fn = fn
+        return self._filter_fn
+
+    @staticmethod
+    def _rekey_dicts(out: DeviceBatch, schema: Schema):
+        # dictionaries are name-keyed; positional renames keep values
+        dicts = {}
+        for i, f in enumerate(schema):
+            d = out.dictionaries.get(out.schema.fields[i].name)
+            if d is not None:
+                dicts[f.name] = d
+        return dicts

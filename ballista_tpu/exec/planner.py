@@ -47,9 +47,23 @@ class TableProvider:
 
 
 class PhysicalPlanner:
-    def __init__(self, provider: TableProvider, partitions: int = 2):
+    def __init__(
+        self,
+        provider: TableProvider,
+        partitions: int = 2,
+        mesh_runtime=None,
+    ):
+        """``mesh_runtime``: a ``ballista_tpu.exec.mesh.MeshRuntime`` when
+        the ICI collective-shuffle tier is active (>= 2 devices and
+        ``ballista.tpu.collective_shuffle`` on). Repartitioned aggregates
+        and partitioned joins then lower to mesh (shard_map + all_to_all)
+        operators instead of the serial coalesce funnel. The distributed
+        (cross-host file/Flight) tier plans with ``mesh_runtime=None`` —
+        mesh operators are process-local and not part of the serde
+        vocabulary."""
         self.provider = provider
         self.partitions = partitions
+        self.mesh_runtime = mesh_runtime
 
     def plan(self, logical: P.LogicalPlan) -> ExecutionPlan:
         return self._plan(logical)
@@ -87,6 +101,12 @@ class PhysicalPlanner:
         if isinstance(node, P.Distinct):
             child = self._plan(node.input)
             groups = [L.Column(f.name) for f in node.input.schema()]
+            if self.mesh_runtime is not None:
+                from ballista_tpu.exec.mesh import MeshAggregateExec
+
+                return MeshAggregateExec(
+                    child, groups, [], self.mesh_runtime
+                )
             partial = HashAggregateExec(child, groups, [], mode="partial")
             return HashAggregateExec(
                 CoalescePartitionsExec(partial), groups, [],
@@ -114,6 +134,16 @@ class PhysicalPlanner:
 
     def _plan_aggregate(self, node: P.Aggregate) -> ExecutionPlan:
         child = self._plan(node.input)
+        if self.mesh_runtime is not None and node.group_exprs:
+            # grouped aggregate -> one mesh program (partial + all_to_all
+            # state exchange + final merge); scalar aggregates stay on the
+            # local funnel (their state is one row — nothing to shuffle)
+            from ballista_tpu.exec.mesh import MeshAggregateExec
+
+            return MeshAggregateExec(
+                child, list(node.group_exprs), list(node.agg_exprs),
+                self.mesh_runtime,
+            )
         partial = HashAggregateExec(
             child, list(node.group_exprs), list(node.agg_exprs), mode="partial"
         )
@@ -140,6 +170,22 @@ class PhysicalPlanner:
             )
         left = self._plan(node.left)
         right = self._plan(node.right)
+        if self.mesh_runtime is not None and (
+            jt == P.JoinType.INNER
+            or (
+                jt in (P.JoinType.LEFT, P.JoinType.SEMI, P.JoinType.ANTI)
+                and node.filter is None
+            )
+        ):
+            # PARTITIONED mode over the mesh. SEMI/ANTI need no build-side
+            # dedup here — the mesh probe counts matches, so duplicate
+            # build keys are existence-correct natively.
+            from ballista_tpu.exec.mesh import MeshJoinExec
+
+            return MeshJoinExec(
+                left, right, list(node.on), jt, node.filter,
+                self.mesh_runtime,
+            )
         if jt in (P.JoinType.SEMI, P.JoinType.ANTI) and node.filter is None:
             # The kernel needs a unique build side; existence semantics allow
             # dedup on the join keys (ref HashJoinExec handles dup builds

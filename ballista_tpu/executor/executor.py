@@ -19,7 +19,7 @@ import grpc
 
 from ballista_tpu.config import BallistaConfig
 from ballista_tpu.errors import ExecutionError
-from ballista_tpu.exec.base import TaskContext
+from ballista_tpu.exec.base import run_with_capacity_retry
 from ballista_tpu.exec.planner import TableProvider
 from ballista_tpu.executor.shuffle import ShuffleWriterExec
 from ballista_tpu.proto import pb
@@ -63,14 +63,15 @@ class Executor:
                 f"(got {type(plan).__name__})"
             )
         props = {kv.key: kv.value for kv in task.props}
-        ctx = TaskContext(
-            config=BallistaConfig(props) if props else BallistaConfig(),
+        out = run_with_capacity_retry(
+            BallistaConfig(props) if props else BallistaConfig(),
+            lambda ctx: plan.execute_shuffle_write(
+                task.task_id.partition_id, ctx
+            ),
             session_id=task.session_id,
             job_id=task.task_id.job_id,
             work_dir=self.work_dir,
         )
-        out = plan.execute_shuffle_write(task.task_id.partition_id, ctx)
-        ctx.raise_deferred()
         self.metrics_collector.record_stage(
             task.task_id.job_id, task.task_id.stage_id,
             task.task_id.partition_id, plan,

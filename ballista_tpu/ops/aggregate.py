@@ -94,9 +94,12 @@ class GroupAggResult:
     def check_overflow(self) -> None:
         """Host-side check — call OUTSIDE jit (forces a device sync)."""
         if bool(self.overflow):
-            raise ExecutionError(
+            from ballista_tpu.errors import CapacityError
+
+            raise CapacityError(
                 f"aggregate exceeded group capacity "
-                f"({int(self.n_groups)} groups); raise ballista.tpu.agg_capacity"
+                f"({int(self.n_groups)} groups); raise ballista.tpu.agg_capacity",
+                required=int(self.n_groups),
             )
 
 

@@ -18,6 +18,7 @@ Layout:
 
 from ballista_tpu.parallel.mesh import (  # noqa: F401
     SHARD_AXIS,
+    is_row_sharded,
     make_mesh,
     shard_batch,
     unshard_batch,

@@ -82,6 +82,21 @@ def shard_batch(
     )
 
 
+def is_row_sharded(batch: DeviceBatch, mesh: Mesh, axis: str = SHARD_AXIS) -> bool:
+    """True when the batch's arrays are already sharded over this mesh's
+    row axis (the invariant mesh stage outputs maintain) — lets a chain of
+    mesh operators compose without host round-trips."""
+    want = NamedSharding(mesh, P(axis))
+    try:
+        return all(
+            getattr(c, "sharding", None) is not None
+            and c.sharding.is_equivalent_to(want, c.ndim)
+            for c in batch.columns + (batch.valid,)
+        )
+    except Exception:
+        return False
+
+
 def unshard_batch(batch: DeviceBatch) -> DeviceBatch:
     """Gather a mesh-sharded batch back to one addressable batch (host
     gather — the client collect path, not a hot path)."""

@@ -8,9 +8,19 @@ files (shuffle_writer.rs:142-292), the scheduler promotes the next stage
 XLA program per mesh: local partial -> ``all_to_all`` over ICI -> local
 final, with no host round-trip between stages.
 
-Capacity/overflow discipline: every shape is static; bucket and group
-overflows come back as per-device flags, checked host-side after the step
-(mirrors ops.aggregate / ops.join overflow style).
+Capacity/overflow discipline: every shape is static; bucket, group and
+expansion overflows come back as SEPARATE per-device flags, checked
+host-side after the step. Retryable overflows (bucket capacity, group
+capacity, join-expansion output capacity) are retried here with grown
+capacities — the mesh runner holds the inputs, so a retry is just a
+re-dispatch of a differently-sized cached program. Non-retryable
+conditions (hash-collision runs past the probe window) raise.
+
+Join tier parity with the local kernels (ops/join.py): all three packing
+modes (exact single-int key, exact2 two-int pack, hashed multi-key with
+window-verified probes), m:n expansion joins for duplicate build keys, and
+INNER-join residual filters — so q5/q18-class join shapes run PARTITIONED
+on the mesh.
 """
 
 from __future__ import annotations
@@ -18,17 +28,27 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.columnar.batch import DeviceBatch, round_capacity
 from ballista_tpu.datatypes import DataType, Field, Schema
-from ballista_tpu.errors import ExecutionError
+from ballista_tpu.errors import CapacityError, ExecutionError
 from ballista_tpu.ops.aggregate import AggOp, group_aggregate
-from ballista_tpu.ops.join import JoinSide, _build_finish, probe_side
+from ballista_tpu.ops.join import (
+    JoinSide,
+    _build_finish,
+    _choose_pack_mode,
+    _pack_key,
+    expand_join,
+    probe_counts,
+)
 from ballista_tpu.ops.perm import multi_key_perm
 from ballista_tpu.parallel.collective import exchange_by_key
 from ballista_tpu.parallel.mesh import SHARD_AXIS
+
+MAX_MESH_RETRIES = 6
 
 
 def _sum_dtype_np(dtype: DataType) -> DataType:
@@ -55,16 +75,6 @@ class MeshStageRunner:
     def _leaf_specs(self, tree):
         return jax.tree_util.tree_map(lambda _: P(self.axis), tree)
 
-    @staticmethod
-    def _check_flags(flags, what: str) -> None:
-        import numpy as np
-
-        if bool(np.any(np.asarray(flags))):
-            raise ExecutionError(
-                f"mesh {what} overflowed a static capacity; raise "
-                "bucket/group capacity"
-            )
-
     # -- repartitioned aggregate ---------------------------------------------
     def aggregate(
         self,
@@ -78,32 +88,40 @@ class MeshStageRunner:
         """Partial agg per device -> all_to_all exchange of group states by
         key hash -> final merge agg per device. Output: sharded batch of
         (keys ++ aggregated values); each group lives on exactly one device.
-        """
-        bucket_cap = bucket_cap or capacity
-        key = (
-            "agg",
-            str(batch.schema),
-            batch.capacity,
-            tuple(key_idxs),
-            tuple(val_idxs),
-            tuple(ops),
-            capacity,
-            bucket_cap,
-            tuple(m is None for m in batch.nulls),
-        )
-        prog = self._programs.get(key)
-        if prog is None:
-            prog = self._compile_aggregate(
+
+        Group-capacity overflow is retried with the exact required capacity
+        (the kernel computes the true group count even on overflow)."""
+        for attempt in range(MAX_MESH_RETRIES):
+            # states per device never exceed `capacity`, so a bucket of
+            # `capacity` slots can always hold one device's worth
+            bcap = bucket_cap or capacity
+            prog = self._aggregate_program(
                 batch, tuple(key_idxs), tuple(val_idxs), tuple(ops),
-                capacity, bucket_cap,
+                capacity, bcap,
             )
-            self._programs[key] = prog
-        out_cols, out_nulls, out_valid, flags = prog(
-            batch.columns, batch.nulls, batch.valid
-        )
-        self._check_flags(flags, "aggregate")
+            out_cols, out_nulls, out_valid, grp_ovf, need = prog(
+                batch.columns, batch.nulls, batch.valid
+            )
+            grp_ovf, need = jax.device_get((grp_ovf, need))
+            if not np.any(grp_ovf):
+                break
+            required = int(np.max(need))
+            new_cap = round_capacity(required + 1)
+            if new_cap <= capacity:
+                new_cap = capacity * 2
+            if attempt == MAX_MESH_RETRIES - 1:
+                raise CapacityError(
+                    "mesh aggregate exceeded group capacity after retries",
+                    required=required,
+                )
+            capacity = new_cap
         in_schema = batch.schema
         fields = [in_schema.fields[i] for i in key_idxs]
+        dicts = {
+            k: v
+            for k, v in batch.dictionaries.items()
+            if any(in_schema.fields[i].name == k for i in key_idxs)
+        }
         for i, op in zip(val_idxs, ops):
             f = in_schema.fields[i]
             if op == AggOp.COUNT:
@@ -113,18 +131,43 @@ class MeshStageRunner:
                     Field(f"{f.name}#sum", _sum_dtype_np(f.dtype), True)
                 )
             else:
-                fields.append(Field(f"{f.name}#{op.value}", f.dtype, True))
+                out_name = f"{f.name}#{op.value}"
+                fields.append(Field(out_name, f.dtype, True))
+                if f.dtype == DataType.STRING:
+                    # MIN/MAX over a dictionary-coded column: the codes ride
+                    # through; the dictionary follows under the renamed field
+                    d = batch.dictionaries.get(f.name)
+                    if d is not None:
+                        dicts[out_name] = d
         return DeviceBatch(
             schema=Schema(fields),
             columns=tuple(out_cols),
             valid=out_valid,
             nulls=tuple(out_nulls),
-            dictionaries={
-                k: v
-                for k, v in batch.dictionaries.items()
-                if any(f.name == k for f in fields)
-            },
+            dictionaries=dicts,
         )
+
+    def _aggregate_program(
+        self, batch, key_idxs, val_idxs, ops, capacity, bucket_cap
+    ):
+        key = (
+            "agg",
+            str(batch.schema),
+            batch.capacity,
+            key_idxs,
+            val_idxs,
+            ops,
+            capacity,
+            bucket_cap,
+            tuple(m is None for m in batch.nulls),
+        )
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._compile_aggregate(
+                batch, key_idxs, val_idxs, ops, capacity, bucket_cap
+            )
+            self._programs[key] = prog
+        return prog
 
     def _compile_aggregate(
         self, batch, key_idxs, val_idxs, ops, capacity, bucket_cap
@@ -157,7 +200,12 @@ class MeshStageRunner:
                 list(merge_ops),
                 capacity,
             )
-            flag = (part.overflow | b_ovf | fin.overflow).reshape(1)
+            # bucket_cap == capacity makes bucket overflow impossible, but
+            # keep the flag folded in as a backstop for explicit bucket_cap
+            grp_ovf = (part.overflow | b_ovf | fin.overflow).reshape(1)
+            need = jnp.maximum(
+                part.n_groups.astype(jnp.int32), fin.n_groups.astype(jnp.int32)
+            ).reshape(1)
             out_cols = tuple(fin.keys) + tuple(fin.values)
             # concrete (possibly all-false) masks so the output pytree has a
             # static structure for out_specs
@@ -167,7 +215,7 @@ class MeshStageRunner:
                     out_cols, tuple(fin.key_nulls) + tuple(fin.value_nulls)
                 )
             )
-            return out_cols, out_nulls, fin.valid, flag
+            return out_cols, out_nulls, fin.valid, grp_ovf, need
 
         in_specs = (
             self._leaf_specs(batch.columns),
@@ -178,6 +226,7 @@ class MeshStageRunner:
         out_specs = (
             tuple(P(axis) for _ in range(n_keys + len(val_idxs))),
             tuple(P(axis) for _ in range(n_keys + len(val_idxs))),
+            P(axis),
             P(axis),
             P(axis),
         )
@@ -196,58 +245,81 @@ class MeshStageRunner:
         right_keys: list[int],
         join_type: JoinSide = JoinSide.INNER,
         bucket_cap: int | None = None,
+        filter_fn=None,
+        out_cap: int | None = None,
     ) -> DeviceBatch:
         """PARTITIONED-mode join (ref HashJoinExecNode PartitionMode
         PARTITIONED, ballista.proto:474-487): exchange BOTH sides by join
-        key over ICI, then build+probe locally per device. Join keys must
-        be single integer columns (the exact-pack tier); the build side
-        must be unique per key (flagged and raised otherwise)."""
-        if len(left_keys) != 1 or len(right_keys) != 1:
-            raise ExecutionError(
-                "mesh partitioned join supports single-column integer keys"
-            )
-        lf = left.schema.fields[left_keys[0]]
-        rf = right.schema.fields[right_keys[0]]
-        for f_ in (lf, rf):
-            if not (f_.dtype.is_integer or f_.dtype == DataType.STRING):
-                raise ExecutionError(
-                    f"mesh join key {f_.name!r} must be integer-backed"
-                )
+        key over ICI, then build+probe locally per device.
+
+        Key packing follows the local tier (ops/join.py): exact single-int,
+        exact2 two-int, or hashed with window-verified probes. Duplicate
+        build keys run the m:n expansion path; the expansion output
+        capacity and the exchange bucket capacity grow on overflow and the
+        program re-dispatches (the inputs are already on device).
+
+        ``filter_fn``: optional traceable residual filter
+        ``f(joined_batch) -> bool[rows]`` applied inside the program
+        (INNER joins only — the caller enforces that restriction).
+        """
         # String keys join by dictionary code. The compiled program bakes no
         # dictionary knowledge, so the shared-dictionary contract must be
         # re-validated on EVERY call (a program-cache hit would otherwise
-        # skip probe_side's trace-time check and join mismatched codes).
-        if DataType.STRING in (lf.dtype, rf.dtype):
-            ld = left.dictionaries.get(lf.name)
-            rd = right.dictionaries.get(rf.name)
-            if ld is None or rd is None or ld.values != rd.values:
-                raise ExecutionError(
-                    f"mesh join key {lf.name!r}/{rf.name!r} requires a "
-                    "shared dictionary; unify dictionaries before sharding"
-                )
-        bucket_cap = bucket_cap or max(
+        # skip the trace-time check and join mismatched codes).
+        for li, ri in zip(left_keys, right_keys):
+            lf = left.schema.fields[li]
+            rf = right.schema.fields[ri]
+            if DataType.STRING in (lf.dtype, rf.dtype):
+                ld = left.dictionaries.get(lf.name)
+                rd = right.dictionaries.get(rf.name)
+                if ld is None or rd is None or ld.values != rd.values:
+                    raise ExecutionError(
+                        f"mesh join key {lf.name!r}/{rf.name!r} requires a "
+                        "shared dictionary; unify dictionaries before "
+                        "sharding"
+                    )
+        # pack mode decided host-side on the build (right) batch — static
+        # for the compiled program; probe packs with the same mode
+        mode = _choose_pack_mode(right, list(right_keys))
+        bcap = bucket_cap or max(
             left.capacity // self.n_dev, right.capacity // self.n_dev, 1
         )
-        key = (
-            "join",
-            str(left.schema), left.capacity,
-            str(right.schema), right.capacity,
-            tuple(left_keys), tuple(right_keys), join_type, bucket_cap,
-            tuple(m is None for m in left.nulls),
-            tuple(m is None for m in right.nulls),
-        )
-        prog = self._programs.get(key)
-        if prog is None:
-            prog = self._compile_join(
+        # post-exchange local probe length is n_dev * bucket_cap; a unique
+        # build emits at most one row per probe row
+        ocap = out_cap or self.n_dev * bcap
+
+        for attempt in range(MAX_MESH_RETRIES):
+            prog = self._join_program(
                 left, right, tuple(left_keys), tuple(right_keys),
-                join_type, bucket_cap,
+                join_type, bcap, mode, ocap, filter_fn,
             )
-            self._programs[key] = prog
-        cols, nulls, valid, flags = prog(
-            left.columns, left.nulls, left.valid,
-            right.columns, right.nulls, right.valid,
-        )
-        self._check_flags(flags, "join exchange/build")
+            cols, nulls, valid, bucket_ovf, run_ovf, exp_ovf, totals = prog(
+                left.columns, left.nulls, left.valid,
+                right.columns, right.nulls, right.valid,
+            )
+            bucket_ovf, run_ovf, exp_ovf, totals = jax.device_get(
+                (bucket_ovf, run_ovf, exp_ovf, totals)
+            )
+            if np.any(run_ovf):
+                raise ExecutionError(
+                    "mesh join build side has a packed-hash collision run "
+                    "longer than the probe window; use integer join keys "
+                    "or reduce build size"
+                )
+            if np.any(bucket_ovf):
+                bcap *= 2
+                ocap = max(ocap, self.n_dev * bcap)
+                continue
+            if np.any(exp_ovf):
+                required = int(np.max(totals))
+                ocap = max(round_capacity(required + 1), ocap * 2)
+                continue
+            break
+        else:
+            raise CapacityError(
+                "mesh join exceeded static capacities after retries",
+                required=int(np.max(totals)),
+            )
         if join_type in (JoinSide.SEMI, JoinSide.ANTI):
             out_schema = left.schema
         elif join_type == JoinSide.LEFT:
@@ -257,7 +329,8 @@ class MeshStageRunner:
         else:
             out_schema = left.schema.join(right.schema)
         dicts = dict(left.dictionaries)
-        dicts.update(right.dictionaries)
+        if join_type not in (JoinSide.SEMI, JoinSide.ANTI):
+            dicts.update(right.dictionaries)
         return DeviceBatch(
             schema=out_schema,
             columns=tuple(cols),
@@ -266,13 +339,37 @@ class MeshStageRunner:
             dictionaries=dicts,
         )
 
+    def _join_program(
+        self, left, right, left_keys, right_keys, join_type, bucket_cap,
+        mode, out_cap, filter_fn,
+    ):
+        key = (
+            "join",
+            str(left.schema), left.capacity,
+            str(right.schema), right.capacity,
+            left_keys, right_keys, join_type, bucket_cap, mode, out_cap,
+            id(filter_fn) if filter_fn is not None else None,
+            tuple(m is None for m in left.nulls),
+            tuple(m is None for m in right.nulls),
+        )
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._compile_join(
+                left, right, left_keys, right_keys, join_type, bucket_cap,
+                mode, out_cap, filter_fn,
+            )
+            self._programs[key] = prog
+        return prog
+
     def _compile_join(
-        self, left, right, left_keys, right_keys, join_type, bucket_cap
+        self, left, right, left_keys, right_keys, join_type, bucket_cap,
+        mode, out_cap, filter_fn,
     ):
         axis, n_dev = self.axis, self.n_dev
         l_schema, r_schema = left.schema, right.schema
         l_dicts = dict(left.dictionaries)
         r_dicts = dict(right.dictionaries)
+        semi_anti = join_type in (JoinSide.SEMI, JoinSide.ANTI)
 
         def f(lcols, lnulls, lvalid, rcols, rnulls, rvalid):
             lc, ln, lv, l_ovf = exchange_by_key(
@@ -281,13 +378,17 @@ class MeshStageRunner:
             rc, rn, rv, r_ovf = exchange_by_key(
                 rcols, rnulls, rvalid, right_keys, axis, n_dev, bucket_cap
             )
-            # build right locally (exact int packing; dups flagged)
+            # build the right side locally under the static pack mode
             dead = ~rv
             for i in right_keys:
                 if rn[i] is not None:
                     dead = dead | rn[i]
-            packed = rc[right_keys[0]].astype(jnp.int64)
-            perm = multi_key_perm([(dead, False), (packed, False)])
+            packed = _pack_key([rc[i] for i in right_keys], mode)
+            passes = [(dead, False), (packed, False)]
+            if mode == "hash":
+                # tie-break on actual keys: duplicate keys land adjacent
+                passes.extend((rc[i], False) for i in right_keys)
+            perm = multi_key_perm(passes)
             rbatch = DeviceBatch(
                 schema=r_schema,
                 columns=rc,
@@ -296,7 +397,7 @@ class MeshStageRunner:
                 dictionaries=r_dicts,
             )
             bt = _build_finish(
-                perm, dead, packed, rbatch, tuple(right_keys), "exact"
+                perm, dead, packed, rbatch, right_keys, mode
             )
             lbatch = DeviceBatch(
                 schema=l_schema,
@@ -305,13 +406,45 @@ class MeshStageRunner:
                 nulls=ln,
                 dictionaries=l_dicts,
             )
-            joined = probe_side(bt, lbatch, list(left_keys), join_type)
-            flag = (l_ovf | r_ovf | bt.has_dups).reshape(1)
+            first, count, live = probe_counts(bt, lbatch, list(left_keys))
+            bucket_ovf = (l_ovf | r_ovf).reshape(1)
+            run_ovf = bt.run_overflow.reshape(1)
+            if semi_anti:
+                m = count > 0
+                keep = m if join_type == JoinSide.SEMI else ~m
+                out = lbatch.with_valid(lbatch.valid & keep)
+                zero = jnp.zeros(1, dtype=jnp.int32)
+                out_nulls = tuple(
+                    jnp.zeros(c.shape[0], dtype=bool) if nm is None else nm
+                    for c, nm in zip(out.columns, out.nulls)
+                )
+                return (
+                    out.columns, out_nulls, out.valid,
+                    bucket_ovf, run_ovf,
+                    jnp.zeros(1, dtype=bool), zero,
+                )
+            if join_type == JoinSide.LEFT:
+                eff = jnp.where(lbatch.valid, jnp.maximum(count, 1), 0)
+                ekind = JoinSide.LEFT
+            else:
+                eff = count
+                ekind = JoinSide.INNER
+            total = jnp.sum(eff).astype(jnp.int32).reshape(1)
+            exp_ovf = (total > out_cap).reshape(1)
+            batch, i, k, real = expand_join(
+                bt, lbatch, first, count, eff, out_cap, ekind
+            )
+            if filter_fn is not None:
+                passes_f = filter_fn(batch) & real
+                batch = batch.with_valid(batch.valid & passes_f)
             out_nulls = tuple(
                 jnp.zeros(c.shape[0], dtype=bool) if m is None else m
-                for c, m in zip(joined.columns, joined.nulls)
+                for c, m in zip(batch.columns, batch.nulls)
             )
-            return joined.columns, out_nulls, joined.valid, flag
+            return (
+                batch.columns, out_nulls, batch.valid,
+                bucket_ovf, run_ovf, exp_ovf, total,
+            )
 
         in_specs = (
             self._leaf_specs(left.columns),
@@ -321,13 +454,16 @@ class MeshStageRunner:
             self._leaf_specs(right.nulls),
             P(axis),
         )
-        if join_type in (JoinSide.SEMI, JoinSide.ANTI):
+        if semi_anti:
             n_out = len(l_schema)
         else:
             n_out = len(l_schema) + len(r_schema)
         out_specs = (
             tuple(P(axis) for _ in range(n_out)),
             tuple(P(axis) for _ in range(n_out)),
+            P(axis),
+            P(axis),
+            P(axis),
             P(axis),
             P(axis),
         )
