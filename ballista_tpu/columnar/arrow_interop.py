@@ -182,6 +182,12 @@ def batch_to_arrow(batch: DeviceBatch) -> pa.RecordBatch:
             arr = pa.nulls(len(col), type=pa.null())
         elif field.dtype == DataType.STRING:
             d = batch.dictionaries.get(field.name)
+            if d is None and len(col) == 0:
+                # zero live rows (e.g. a hash bucket that received no
+                # groups): there is nothing to decode — emit empty strings
+                arr = pa.array([], type=pa.string())
+                arrays.append(arr)
+                continue
             if d is None:
                 raise SchemaError(f"no dictionary for string column {field.name!r}")
             if len(d) == 0:

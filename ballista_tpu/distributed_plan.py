@@ -14,10 +14,12 @@ Boundary rules adapted to this engine's operators:
   a broadcast-like boundary: the build fragment materializes as a
   single-partition shuffle so every probe task can fetch it (the
   COLLECT_LEFT mode of the reference, proto:474-487).
-- An explicit hash ``ShuffleWriterExec`` with partition keys corresponds to
-  the reference's RepartitionExec(Hash) arm (planner.rs:133-157); the
-  single-process planner does not emit those yet, so stages here hash-
-  partition only at the terminal write when requested.
+- ``HashRepartitionExec`` -> stage boundary with ``Partitioning::Hash``,
+  exactly the reference's RepartitionExec(Hash) arm (planner.rs:133-157):
+  the upstream fragment's ShuffleWriter hash-partitions into K buckets and
+  K downstream tasks each read their bucket from every writer. The
+  physical planner emits these at aggregate/join exchange points when
+  planning for the distributed tier (``ballista.repartition.*``).
 """
 
 from __future__ import annotations
@@ -122,6 +124,26 @@ class DistributedPlanner:
             self._plan_node(job_id, c, stages) for c in plan.children()
         ]
 
+        from ballista_tpu.exec.repartition import HashRepartitionExec
+
+        if isinstance(plan, HashRepartitionExec):
+            # hash-exchange boundary (ref planner.rs:133-157): the child
+            # fragment becomes a stage whose ShuffleWriter hash-partitions
+            # its output into K buckets; downstream tasks each read their
+            # bucket from every writer
+            (child,) = children
+            writer = ShuffleWriterExec(
+                job_id, self._new_stage_id(), child, list(plan.keys),
+                plan.partitions,
+            )
+            stages.append(QueryStage(job_id, writer.stage_id, writer))
+            return UnresolvedShuffleExec(
+                writer.stage_id,
+                child.schema(),
+                child.output_partitioning().n,
+                plan.partitions,
+            )
+
         if isinstance(plan, CoalescePartitionsExec):
             # stage boundary: child fragment keeps its partitioning; the new
             # stage's tasks each write one output file (ref planner.rs:104-132)
@@ -139,8 +161,16 @@ class DistributedPlanner:
             return CoalescePartitionsExec(reader_placeholder)
 
         if isinstance(plan, HashJoinExec):
-            # the collected (build) side becomes its own single-output stage
             left, right = children
+            if plan.partition_mode == "partitioned":
+                # both sides already cut at their HashRepartitionExec
+                # boundaries (children are shuffle placeholders); the join
+                # runs one task per hash bucket
+                return HashJoinExec(
+                    left, right, plan.on, plan.join_type, plan.filter,
+                    partition_mode="partitioned",
+                )
+            # the collected (build) side becomes its own single-output stage
             right = self._materialize_collected(job_id, right, stages)
             return HashJoinExec(
                 left, right, plan.on, plan.join_type, plan.filter

@@ -294,7 +294,11 @@ class HashAggregateExec(ExecutionPlan):
     def output_partitioning(self):
         if self.mode == "partial":
             return self.input.output_partitioning()
-        return UnknownPartitioning(1)
+        # final mode merges per input partition: beneath a coalesce this is
+        # the classic 1-partition funnel; beneath a hash repartition (or a
+        # resolved shuffle read) it is K parallel merge tasks, each owning
+        # the groups of its hash bucket (ref planner.rs:133-157)
+        return UnknownPartitioning(self.input.output_partitioning().n)
 
     def describe(self) -> str:
         g = ", ".join(self.spec.group_names)
@@ -473,10 +477,10 @@ class HashAggregateExec(ExecutionPlan):
     def _execute_final(
         self, partition: int, ctx: TaskContext, cap: int, n_groups: int
     ) -> Iterator[DeviceBatch]:
-        states = []
-        part = self.input.output_partitioning()
-        for p in range(part.n):
-            states.extend(self.input.execute(p, ctx))
+        # merge ONLY this output partition's input partition: the planner
+        # guarantees the input is either a 1-partition coalesce (funnel) or
+        # a hash repartition on the group keys (K parallel merges)
+        states = list(self.input.execute(partition, ctx))
         if not states:
             return
         merge_ops = [s.op.merge_op for s in self.spec.slots]

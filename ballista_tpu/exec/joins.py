@@ -50,6 +50,16 @@ def _collect(plan: ExecutionPlan, ctx: TaskContext) -> DeviceBatch:
     return concat_batches(batches)
 
 
+def _collect_partition(
+    plan: ExecutionPlan, ctx: TaskContext, partition: int
+) -> DeviceBatch:
+    """PARTITIONED-mode build collection: only this task's hash bucket."""
+    batches = list(plan.execute(partition, ctx))
+    if not batches:
+        return DeviceBatch.empty(plan.schema())
+    return concat_batches(batches)
+
+
 # build_side host-composes cached sort passes (wrapping it in another jit
 # would re-inline the sorts into one slow-compiling program — don't); the
 # probe is a single fast-compiling program per shape.
@@ -89,13 +99,22 @@ class HashJoinExec(ExecutionPlan):
         on: list[tuple[L.Expr, L.Expr]],
         join_type: JoinType,
         filter: L.Expr | None = None,
+        partition_mode: str = "collect",
     ) -> None:
+        """``partition_mode``: "collect" broadcasts the whole build side to
+        every probe task (the reference's COLLECT_LEFT); "partitioned"
+        assumes BOTH inputs are hash-partitioned on the join keys (the
+        planner inserts HashRepartitionExec) and each task joins only its
+        bucket (ref PartitionMode, ballista.proto:474-487)."""
         super().__init__()
+        if partition_mode not in ("collect", "partitioned"):
+            raise PlanError(f"bad join partition mode {partition_mode!r}")
         self.left = left
         self.right = right
         self.on = list(on)
         self.join_type = join_type
         self.filter = filter
+        self.partition_mode = partition_mode
         self._filtered_probe_cache: dict = {}
         ls, rs = left.schema(), right.schema()
         for a, b in self.on:
@@ -124,7 +143,10 @@ class HashJoinExec(ExecutionPlan):
     def describe(self) -> str:
         on = ", ".join(f"{a.name()} = {b.name()}" for a, b in self.on)
         f = f", filter={self.filter.name()}" if self.filter is not None else ""
-        return f"HashJoinExec({self.join_type.value}): on=[{on}]{f}"
+        return (
+            f"HashJoinExec({self.join_type.value}, "
+            f"{self.partition_mode}): on=[{on}]{f}"
+        )
 
     # -- dictionaries ---------------------------------------------------------
     def _unify_key_dicts(
@@ -170,6 +192,12 @@ class HashJoinExec(ExecutionPlan):
         left_keys = [L.resolve_field_index(ls, a.cname) for a, _ in self.on]
         right_keys = [L.resolve_field_index(rs, b.cname) for _, b in self.on]
 
+        if self.partition_mode == "partitioned":
+            yield from self._execute_partitioned(
+                partition, ctx, left_keys, right_keys
+            )
+            return
+
         if self.join_type == JoinType.INNER:
             yield from self._execute_inner(partition, ctx, left_keys, right_keys)
             return
@@ -177,21 +205,50 @@ class HashJoinExec(ExecutionPlan):
         # LEFT/SEMI/ANTI: left side is preserved => left probes, right builds.
         with self.metrics.time("build_time"):
             build_batch = _collect(self.right, ctx)
-        kind = {
-            JoinType.LEFT: JoinSide.LEFT,
-            JoinType.SEMI: JoinSide.SEMI,
-            JoinType.ANTI: JoinSide.ANTI,
-        }[self.join_type]
+        yield from self._probe_loop(
+            partition, ctx, build_batch, left_keys, right_keys,
+            self._KIND[self.join_type],
+        )
+
+    _KIND = {
+        JoinType.INNER: JoinSide.INNER,
+        JoinType.LEFT: JoinSide.LEFT,
+        JoinType.SEMI: JoinSide.SEMI,
+        JoinType.ANTI: JoinSide.ANTI,
+    }
+
+    def _execute_partitioned(
+        self, partition, ctx, left_keys, right_keys
+    ) -> Iterator[DeviceBatch]:
+        """PARTITIONED mode: both inputs are hash-partitioned on the join
+        keys, so this task's bucket is join-complete on its own. Duplicate
+        build keys take the m:n expansion path per bucket — no flip, no
+        single-partition funnel (every bucket runs in parallel)."""
+        with self.metrics.time("build_time"):
+            build_batch = _collect_partition(self.right, ctx, partition)
+        yield from self._probe_loop(
+            partition, ctx, build_batch, left_keys, right_keys,
+            self._KIND[self.join_type],
+        )
+
+    def _probe_loop(
+        self, partition, ctx, build_batch, left_keys, right_keys, kind
+    ) -> Iterator[DeviceBatch]:
+        """Shared probe driver: unify key dictionaries per probe batch,
+        rebuild only when remapping changed the build side (overflow is
+        checked inside _probe_or_expand's flag fetch), probe or expand,
+        relabel the output to the plan schema."""
         bt = None
         for b in self.left.execute(partition, ctx):
             bb, pb = self._unify_key_dicts(build_batch, b, right_keys, left_keys)
             if bt is None or bb is not build_batch:
-                # rebuild only when dictionary remapping changed the build
-                # (overflow is checked inside _probe_or_expand's flag fetch)
                 with self.metrics.time("build_time"):
                     bt = build_side(bb, right_keys)
                 build_batch = bb
             out = self._probe_or_expand(bt, pb, left_keys, kind)
+            if kind in (JoinSide.INNER, JoinSide.LEFT):
+                # probe++build == left++right; relabel to the plan schema
+                out = self._restore_column_order(out, pb, bt.batch, True)
             self.metrics.add("output_batches")
             yield out
 

@@ -11,6 +11,7 @@ pre-projected by the SQL planner).
 
 from __future__ import annotations
 
+from ballista_tpu.datatypes import DataType
 from ballista_tpu.errors import PlanError
 from ballista_tpu.exec.aggregate import HashAggregateExec
 from ballista_tpu.exec.base import ExecutionPlan
@@ -52,6 +53,8 @@ class PhysicalPlanner:
         provider: TableProvider,
         partitions: int = 2,
         mesh_runtime=None,
+        config=None,
+        distributed: bool = False,
     ):
         """``mesh_runtime``: a ``ballista_tpu.exec.mesh.MeshRuntime`` when
         the ICI collective-shuffle tier is active (>= 2 devices and
@@ -60,10 +63,35 @@ class PhysicalPlanner:
         operators instead of the serial coalesce funnel. The distributed
         (cross-host file/Flight) tier plans with ``mesh_runtime=None`` —
         mesh operators are process-local and not part of the serde
-        vocabulary."""
+        vocabulary.
+
+        ``distributed``: plan for the multi-executor tier — insert
+        ``HashRepartitionExec`` boundaries at aggregates/joins (honoring
+        ``ballista.repartition.aggregations/joins``) so the stage splitter
+        can cut hash-shuffle exchanges there (ref planner.rs:133-157). The
+        in-process tier leaves them out: a single device gains nothing
+        from masked K-way fan-out."""
         self.provider = provider
         self.partitions = partitions
         self.mesh_runtime = mesh_runtime
+        self.config = config
+        self.distributed = distributed
+
+    def _repartition_aggregations(self) -> bool:
+        return (
+            self.distributed
+            and self.partitions > 1
+            and (
+                self.config is None or self.config.repartition_aggregations()
+            )
+        )
+
+    def _repartition_joins(self) -> bool:
+        return (
+            self.distributed
+            and self.partitions > 1
+            and (self.config is None or self.config.repartition_joins())
+        )
 
     def plan(self, logical: P.LogicalPlan) -> ExecutionPlan:
         return self._plan(logical)
@@ -114,7 +142,14 @@ class PhysicalPlanner:
                 planned_input_schema=partial.planned_input_schema,
             )
         if isinstance(node, P.Sort):
-            return SortExec(self._plan(node.input), list(node.sort_exprs))
+            child = self._plan(node.input)
+            if self.distributed and child.output_partitioning().n > 1:
+                # explicit gather boundary: the stage splitter cuts here, so
+                # an upstream K-way final aggregate keeps its K parallel
+                # tasks and only the sort itself runs single-task (ref
+                # 3-stage q1 golden plan, planner.rs:328-344)
+                child = CoalescePartitionsExec(child)
+            return SortExec(child, list(node.sort_exprs))
         if isinstance(node, P.Limit):
             child = self._plan(node.input)
             if child.output_partitioning().n > 1:
@@ -147,7 +182,19 @@ class PhysicalPlanner:
         partial = HashAggregateExec(
             child, list(node.group_exprs), list(node.agg_exprs), mode="partial"
         )
-        merged = CoalescePartitionsExec(partial)
+        if node.group_exprs and self._repartition_aggregations():
+            # hash-exchange the partial states on the group keys: the final
+            # merge becomes K parallel tasks, one per hash bucket (ref
+            # planner.rs:133-157 + the 3-stage q1 golden plan :328-344)
+            from ballista_tpu.exec.repartition import HashRepartitionExec
+
+            ng = len(node.group_exprs)
+            keys = [
+                L.Column(f.name) for f in partial.schema().fields[:ng]
+            ]
+            merged = HashRepartitionExec(partial, keys, self.partitions)
+        else:
+            merged = CoalescePartitionsExec(partial)
         return HashAggregateExec(
             merged, list(node.group_exprs), list(node.agg_exprs),
             mode="final", spec=partial.spec,
@@ -185,6 +232,37 @@ class PhysicalPlanner:
             return MeshJoinExec(
                 left, right, list(node.on), jt, node.filter,
                 self.mesh_runtime,
+            )
+        # STRING keys are dictionary-coded; two executors cannot hash-route
+        # codes consistently without a shared dictionary, so string-keyed
+        # joins stay in collect (broadcast-build) mode.
+        no_string_keys = all(
+            a.data_type(node.left.schema()) != DataType.STRING
+            and b.data_type(node.right.schema()) != DataType.STRING
+            for a, b in node.on
+        )
+        if (
+            self._repartition_joins()
+            and no_string_keys
+            and jt in (
+                P.JoinType.INNER, P.JoinType.LEFT, P.JoinType.SEMI,
+                P.JoinType.ANTI,
+            )
+        ):
+            # PARTITIONED mode: hash-exchange both sides on the join keys;
+            # each of K tasks joins its bucket (ref planner.rs:133-157 +
+            # the 5-stage join golden plan :442-471). Duplicate build keys
+            # run the per-bucket expansion path, so no dedup pre-pass is
+            # needed even for SEMI/ANTI.
+            from ballista_tpu.exec.repartition import HashRepartitionExec
+
+            lkeys = [a for a, _ in node.on]
+            rkeys = [b for _, b in node.on]
+            left = HashRepartitionExec(left, lkeys, self.partitions)
+            right = HashRepartitionExec(right, rkeys, self.partitions)
+            return HashJoinExec(
+                left, right, list(node.on), jt, node.filter,
+                partition_mode="partitioned",
             )
         if jt in (P.JoinType.SEMI, P.JoinType.ANTI) and node.filter is None:
             # The kernel needs a unique build side; existence semantics allow

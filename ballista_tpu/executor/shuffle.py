@@ -36,14 +36,18 @@ from ballista_tpu.exec.base import (
     UnknownPartitioning,
 )
 from ballista_tpu.expr import logical as L
-from ballista_tpu.ops.partition import partition_ids
+from ballista_tpu.ops.partition import partition_ids, string_key_tables
 from ballista_tpu.scheduler_types import ShuffleWritePartitionMeta
 
 
 @functools.lru_cache(maxsize=None)
 def _jit_partition_ids(key_idxs: tuple, num_partitions: int):
+    # dict_tables ride as runtime args (they change per batch dictionary;
+    # baking them at trace time would mis-route later batches)
     return jax.jit(
-        lambda b: partition_ids(b, list(key_idxs), num_partitions)
+        lambda b, tables: partition_ids(
+            b, list(key_idxs), num_partitions, tables
+        )
     )
 
 
@@ -119,9 +123,10 @@ class ShuffleWriterExec(ExecutionPlan):
                         appender(0).write(rb)
                     continue
                 with self.metrics.time("repart_time"):
+                    tables = string_key_tables(batch, list(key_idxs))
                     pids = np.asarray(
                         _jit_partition_ids(key_idxs, self.output_partitions)(
-                            batch
+                            batch, tables
                         )
                     )
                 rb = batch_to_arrow(batch)

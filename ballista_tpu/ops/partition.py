@@ -18,10 +18,40 @@ under the mask).
 
 from __future__ import annotations
 
+import hashlib
+
 import jax.numpy as jnp
+import numpy as np
 
 from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.datatypes import DataType
 from ballista_tpu.ops.hashing import hash_columns
+
+_dict_hash_cache: dict[tuple[str, ...], np.ndarray] = {}
+
+
+def _stable_string_hashes(values: tuple[str, ...]) -> np.ndarray:
+    """Deterministic (cross-process) 64-bit hash per dictionary value.
+
+    STRING columns are dictionary-coded per batch, and two executors may
+    assign the same string different codes — so routing MUST hash the
+    string VALUE, not its code, or the same group/join key splits across
+    shuffle buckets. blake2b is stable across processes (unlike Python's
+    salted hash)."""
+    cached = _dict_hash_cache.get(values)
+    if cached is None:
+        cached = np.array(
+            [
+                int.from_bytes(
+                    hashlib.blake2b(v.encode(), digest_size=8).digest(),
+                    "little",
+                )
+                for v in values
+            ],
+            dtype=np.uint64,
+        )
+        _dict_hash_cache[values] = cached
+    return cached
 
 
 def partition_ids_for(
@@ -42,12 +72,53 @@ def partition_ids_for(
     return jnp.where(valid, pid, num_partitions)
 
 
+def string_key_tables(
+    batch: DeviceBatch, key_idxs: list[int]
+) -> tuple[jnp.ndarray | None, ...]:
+    """Per key column: the stable-hash lookup table for STRING keys (None
+    for non-string keys). Computed OUTSIDE jit and passed in as a runtime
+    argument — callers cache their partition programs by (keys, n) only,
+    and a dictionary baked in as a trace-time constant would go stale when
+    a later batch carries a different dictionary."""
+    out: list[jnp.ndarray | None] = []
+    for i in key_idxs:
+        f = batch.schema.fields[i]
+        d = (
+            batch.dictionaries.get(f.name)
+            if f.dtype == DataType.STRING
+            else None
+        )
+        if d is not None and len(d.values):
+            out.append(jnp.asarray(_stable_string_hashes(d.values)))
+        else:
+            out.append(None)
+    return tuple(out)
+
+
 def partition_ids(
-    batch: DeviceBatch, key_idxs: list[int], num_partitions: int
+    batch: DeviceBatch,
+    key_idxs: list[int],
+    num_partitions: int,
+    dict_tables: tuple[jnp.ndarray | None, ...] | None = None,
 ) -> jnp.ndarray:
-    """DeviceBatch wrapper over ``partition_ids_for``."""
+    """DeviceBatch wrapper over ``partition_ids_for``.
+
+    STRING key columns are translated from per-batch dictionary codes to
+    stable per-VALUE hashes (device gather through the hashed dictionary
+    in ``dict_tables``) before routing, so executors with different
+    dictionaries still route equal strings to the same shuffle bucket.
+    The ICI tier doesn't need this: mesh inputs share one unified
+    dictionary by construction."""
+    if dict_tables is None:
+        dict_tables = string_key_tables(batch, key_idxs)
+    cols = []
+    for i, table in zip(key_idxs, dict_tables):
+        col = batch.columns[i]
+        if table is not None:
+            col = table[jnp.clip(col, 0, table.shape[0] - 1)]
+        cols.append(col)
     return partition_ids_for(
-        [batch.columns[i] for i in key_idxs],
+        cols,
         [batch.nulls[i] for i in key_idxs],
         batch.valid,
         num_partitions,

@@ -162,8 +162,14 @@ class SchedulerServer:
     def submit_logical(self, logical, session_id: str) -> str:
         cfg = self.sessions.get(session_id, self.config)
         optimized = optimize(logical)
+        # distributed=True inserts HashRepartitionExec exchange boundaries
+        # (honoring ballista.repartition.*) so the stage splitter can cut
+        # multi-partition hash shuffles (ref planner.rs:133-157)
         physical = PhysicalPlanner(
-            self.provider, cfg.default_shuffle_partitions()
+            self.provider,
+            cfg.default_shuffle_partitions(),
+            config=cfg,
+            distributed=True,
         ).plan(optimized)
         return self.submit_physical(physical, session_id)
 

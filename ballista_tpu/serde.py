@@ -27,6 +27,7 @@ from ballista_tpu.exec.pipeline import (
     RenameExec,
 )
 from ballista_tpu.exec.planner import TableProvider
+from ballista_tpu.exec.repartition import HashRepartitionExec
 from ballista_tpu.exec.scan import CsvScanExec, MemoryScanExec, ParquetScanExec
 from ballista_tpu.exec.sort import GlobalLimitExec, SortExec
 from ballista_tpu.expr import logical as L
@@ -536,10 +537,19 @@ class BallistaCodec:
                     for a, b in plan.on
                 ],
                 join_type=getattr(pb, f"JOIN_{plan.join_type.name}"),
+                partition_mode=plan.partition_mode,
             )
             if plan.filter is not None:
                 node.filter.CopyFrom(expr_to_proto(plan.filter))
             return pb.PhysicalPlanNode(join=node)
+        if isinstance(plan, HashRepartitionExec):
+            return pb.PhysicalPlanNode(
+                repartition=pb.PhysicalRepartitionNode(
+                    input=self.physical_to_proto(plan.input),
+                    keys=[expr_to_proto(k) for k in plan.keys],
+                    partitions=plan.partitions,
+                )
+            )
         if isinstance(plan, CrossJoinExec):
             return pb.PhysicalPlanNode(
                 cross_join=pb.PhysicalBinaryNode(
@@ -721,6 +731,14 @@ class BallistaCodec:
                 ],
                 P.JoinType[pb.JoinTypeP.Name(n.join_type)[5:]],
                 expr_from_proto(n.filter) if n.HasField("filter") else None,
+                partition_mode=n.partition_mode or "collect",
+            )
+        if kind == "repartition":
+            n = p.repartition
+            return HashRepartitionExec(
+                self.physical_from_proto(n.input),
+                [expr_from_proto(k) for k in n.keys],
+                int(n.partitions),
             )
         if kind == "cross_join":
             return CrossJoinExec(
