@@ -30,7 +30,13 @@ from ballista_tpu.exec.base import (
 )
 from ballista_tpu.expr import logical as L
 from ballista_tpu.expr.physical import compile_expr
-from ballista_tpu.ops.aggregate import AggOp, group_aggregate, scalar_aggregate
+from ballista_tpu.ops.aggregate import (
+    DENSE_AGG_MAX_SLOTS,
+    AggOp,
+    dense_group_aggregate,
+    group_aggregate,
+    scalar_aggregate,
+)
 from ballista_tpu.ops.concat import concat_batches
 
 
@@ -348,10 +354,19 @@ class HashAggregateExec(ExecutionPlan):
         # kernel capacity — keeps small batches cheap even when the session
         # capacity was grown for a big merge
         cap = min(cap, max(batch.capacity, 16))
-        res = group_aggregate(
-            key_cols, key_nulls, batch.valid, val_cols, val_nulls,
-            list(ops), cap,
-        )
+        # dictionary-coded / boolean keys with a small domain take the dense
+        # (sort-free, one-fused-scatter) kernel — the q1 shape
+        vocab = self._dense_vocab(batch, n_groups)
+        if vocab is not None:
+            res = dense_group_aggregate(
+                key_cols, key_nulls, vocab, batch.valid, val_cols,
+                val_nulls, list(ops),
+            )
+        else:
+            res = group_aggregate(
+                key_cols, key_nulls, batch.valid, val_cols, val_nulls,
+                list(ops), cap,
+            )
         if ctx is not None:
             ctx.defer_check(
                 res.overflow,
@@ -388,6 +403,30 @@ class HashAggregateExec(ExecutionPlan):
             nulls=out.nulls,
             dictionaries=dicts,
         )
+
+    @staticmethod
+    def _dense_vocab(batch: DeviceBatch, n_groups: int) -> list[int] | None:
+        """Vocab sizes when EVERY group key is dictionary-coded (STRING) or
+        BOOL and the dense slot space stays small; None otherwise."""
+        if n_groups == 0:
+            return None
+        vocab: list[int] = []
+        slots = 1
+        for i in range(n_groups):
+            f = batch.schema.fields[i]
+            if f.dtype == DataType.STRING:
+                d = batch.dictionaries.get(f.name)
+                if d is None or len(d.values) == 0:
+                    return None
+                vocab.append(len(d.values))
+            elif f.dtype == DataType.BOOL:
+                vocab.append(2)
+            else:
+                return None
+            slots *= vocab[-1] + 1
+            if slots > DENSE_AGG_MAX_SLOTS:
+                return None
+        return vocab
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
         cap = self._agg_capacity(ctx)

@@ -95,17 +95,26 @@ class TaskContext:
 AGG_CAPACITY_HARD_MAX = 1 << 23
 
 
-def run_with_capacity_retry(config: BallistaConfig, fn, **ctx_fields):
+def run_with_capacity_retry(
+    config: BallistaConfig, fn, hint: dict | None = None, **ctx_fields
+):
     """Centralized execution driver: build a TaskContext, run ``fn(ctx)``,
     raise any deferred device checks, and on a CapacityError retry with the
     capacity grown to fit (exact when the kernel reported the true group
     count, else doubled). Every entry point that executes plans —
     DataFrame.collect, the executor's shuffle-write task, the mesh runner —
     routes through here so the deferred-check invariant cannot be missed
-    (a forgotten raise_deferred would silently truncate results)."""
+    (a forgotten raise_deferred would silently truncate results).
+
+    ``hint``: a caller-owned mutable dict remembering the capacity a
+    previous run grew to (key ``"agg_capacity"``) — warm re-runs of the
+    same workload then start at the working capacity instead of paying the
+    overflow+retry round every time."""
     from ballista_tpu.errors import CapacityError
 
-    override: int | None = None
+    override: int | None = (hint or {}).get("agg_capacity")
+    if override is not None and override <= config.agg_capacity():
+        override = None
     while True:
         ctx = TaskContext(
             config=config, agg_capacity_override=override, **ctx_fields
@@ -113,6 +122,10 @@ def run_with_capacity_retry(config: BallistaConfig, fn, **ctx_fields):
         try:
             out = fn(ctx)
             ctx.raise_deferred()
+            if override is not None and hint is not None:
+                hint["agg_capacity"] = max(
+                    hint.get("agg_capacity", 0), override
+                )
             return out
         except CapacityError as e:
             ctx.deferred_checks.clear()

@@ -51,6 +51,8 @@ class TpuContext(Catalog, TableProvider):
         self.tables: dict[str, _Registered] = {}
         self._mesh_runtime = None
         self._mesh_checked = False
+        # remembered adaptive-capacity growth (see run_with_capacity_retry)
+        self._capacity_hint: dict = {}
 
     def mesh_runtime(self):
         """The ICI collective-shuffle runtime, when this process sees >= 2
@@ -122,7 +124,13 @@ class TpuContext(Catalog, TableProvider):
         if r is None:
             raise PlanError(f"table {table!r} not found")
         if r.kind == "memory":
-            return MemoryScanExec(r.kw["table"], r.schema, projection, partitions)
+            # table-lifetime device cache: warm queries re-serve resident
+            # device arrays instead of re-uploading the table
+            cache = r.kw.setdefault("device_cache", {})
+            return MemoryScanExec(
+                r.kw["table"], r.schema, projection, partitions,
+                device_cache=cache,
+            )
         if r.kind == "csv":
             return CsvScanExec(
                 r.kw["path"], r.schema, r.kw["has_header"], r.kw["delimiter"],
@@ -248,8 +256,11 @@ class DataFrame:
 
         # run_with_capacity_retry raises deferred device checks in one
         # batched fetch and, on aggregate-capacity overflow, re-runs the
-        # plan with the capacity grown to the reported group count
-        record_batches = run_with_capacity_retry(self.ctx.config, run)
+        # plan with the capacity grown to the reported group count; the
+        # context-level hint makes warm re-runs start at the grown size
+        record_batches = run_with_capacity_retry(
+            self.ctx.config, run, hint=self.ctx._capacity_hint
+        )
         if not record_batches:
             from ballista_tpu.columnar.arrow_interop import schema_to_arrow
 

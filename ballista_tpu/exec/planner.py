@@ -109,13 +109,16 @@ class PhysicalPlanner:
                     )
                 else:
                     scan = ParquetScanExec(
-                        path, node.source_schema, projection, self.partitions
+                        path, node.source_schema, projection, self.partitions,
+                        predicates=list(node.filters),
                     )
                 scan.table_name = node.table_name
             else:
                 scan = self.provider.scan(
                     node.table_name, projection, self.partitions
                 )
+                if isinstance(scan, ParquetScanExec):
+                    scan.predicates = list(node.filters)
                 scan.table_name = node.table_name
             for f in node.filters:
                 scan = FilterExec(scan, f)

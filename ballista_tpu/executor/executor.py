@@ -45,6 +45,8 @@ class Executor:
         self.work_dir = work_dir
         self.provider = provider
         self.codec = BallistaCodec(provider=provider)
+        # adaptive-capacity memory across tasks (run_with_capacity_retry)
+        self._capacity_hint: dict = {}
         from ballista_tpu.executor.metrics import LoggingMetricsCollector
 
         self.metrics_collector = metrics_collector or LoggingMetricsCollector()
@@ -68,6 +70,7 @@ class Executor:
             lambda ctx: plan.execute_shuffle_write(
                 task.task_id.partition_id, ctx
             ),
+            hint=self._capacity_hint,
             session_id=task.session_id,
             job_id=task.task_id.job_id,
             work_dir=self.work_dir,

@@ -1,0 +1,157 @@
+"""Push-mode executor server.
+
+ref ballista/rust/executor/src/executor_server.rs:49-354:
+``startup`` starts the ExecutorGrpc service, registers with the scheduler
+(RegisterExecutor, carrying the grpc_port the scheduler dials back), starts
+a Heartbeater (60s, :273-283) and a task runner pool consuming LaunchTask
+queues (:294-330). Each finished task pushes UpdateTaskStatus back to the
+scheduler (:176-254). StopExecutor — ``todo!()`` in the reference
+(:348-353) — is implemented here as a graceful drain + stop.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import traceback
+
+import grpc
+
+from ballista_tpu.executor.executor import Executor, as_task_status
+from ballista_tpu.proto import pb
+from ballista_tpu.scheduler.rpc import (
+    EXECUTOR_METHODS,
+    EXECUTOR_SERVICE,
+    add_service,
+    scheduler_stub,
+)
+
+log = logging.getLogger(__name__)
+
+HEARTBEAT_INTERVAL_S = 60.0  # ref executor_server.rs:273-283
+
+
+class ExecutorServer:
+    """Push-mode executor process body."""
+
+    def __init__(
+        self,
+        executor: Executor,
+        scheduler_addr: str,
+        flight_host: str,
+        flight_port: int,
+        task_slots: int = 4,
+        heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S,
+    ) -> None:
+        self.executor = executor
+        self.scheduler_addr = scheduler_addr
+        self.flight_host = flight_host
+        self.flight_port = flight_port
+        self.task_slots = task_slots
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self._queue: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._grpc_server: grpc.Server | None = None
+        self.grpc_port: int = 0
+        self._channel: grpc.Channel | None = None
+        self._sched = None
+
+    # -- gRPC service (ExecutorGrpc) -----------------------------------------
+    def LaunchTask(self, request: pb.LaunchTaskParams, context):
+        """ref executor_server.rs:336-346 — enqueue, workers pick up."""
+        for task in request.tasks:
+            self._queue.put(task)
+        return pb.LaunchTaskResult(success=True)
+
+    def StopExecutor(self, request, context):
+        self._stop.set()
+        return pb.StopExecutorResult()
+
+    # -- lifecycle -----------------------------------------------------------
+    def startup(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Start service + register + heartbeater + runner pool. Returns
+        the bound grpc port (ref startup :49-108)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        gs = grpc.server(ThreadPoolExecutor(max_workers=8))
+        add_service(gs, EXECUTOR_SERVICE, EXECUTOR_METHODS, self)
+        self.grpc_port = gs.add_insecure_port(f"{host}:{port}")
+        gs.start()
+        self._grpc_server = gs
+
+        self._channel = grpc.insecure_channel(self.scheduler_addr)
+        self._sched = scheduler_stub(self._channel)
+        self._sched.RegisterExecutor(
+            pb.RegisterExecutorParams(metadata=self._metadata())
+        )
+
+        hb = threading.Thread(
+            target=self._heartbeat_loop, daemon=True, name="heartbeater"
+        )
+        hb.start()
+        self._threads.append(hb)
+        # ref: 4-thread DedicatedExecutor pool (:294-330); on TPU the
+        # compute runs on-device so host threads stay light
+        for i in range(self.task_slots):
+            t = threading.Thread(
+                target=self._runner_loop, daemon=True, name=f"task-runner-{i}"
+            )
+            t.start()
+            self._threads.append(t)
+        return self.grpc_port
+
+    def _metadata(self) -> pb.ExecutorMetadata:
+        return pb.ExecutorMetadata(
+            id=self.executor.executor_id,
+            host=self.flight_host,
+            port=self.flight_port,
+            grpc_port=self.grpc_port,
+            specification=pb.ExecutorSpecification(task_slots=self.task_slots),
+        )
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval_s):
+            try:
+                self._sched.HeartBeatFromExecutor(
+                    pb.HeartBeatParams(executor_id=self.executor.executor_id)
+                )
+            except grpc.RpcError as e:
+                log.warning("heartbeat failed: %s", e)
+
+    def _runner_loop(self) -> None:
+        """ref run_task :176-254 — decode, execute, push status back."""
+        while not self._stop.is_set():
+            try:
+                task = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            error = None
+            result = []
+            try:
+                result = self.executor.execute_shuffle_write(task)
+            except BaseException as e:  # noqa: BLE001 (catch_unwind parity)
+                error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+                log.error("task %s failed: %s", task.task_id, error)
+            status = as_task_status(
+                task.task_id, self.executor.executor_id, result, error
+            )
+            try:
+                self._sched.UpdateTaskStatus(
+                    pb.UpdateTaskStatusParams(
+                        executor_id=self.executor.executor_id,
+                        task_status=[status],
+                    )
+                )
+            except grpc.RpcError as e:
+                log.warning("UpdateTaskStatus failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace=None)
+        if self._channel is not None:
+            self._channel.close()

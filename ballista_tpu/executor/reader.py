@@ -26,7 +26,7 @@ from ballista_tpu.exec.base import (
 )
 from ballista_tpu.scheduler_types import PartitionLocation
 
-BATCH_ROWS = 1 << 16
+BATCH_ROWS = 1 << 17
 
 
 def fetch_partition_table(loc: PartitionLocation) -> pa.Table:

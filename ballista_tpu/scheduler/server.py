@@ -87,6 +87,14 @@ class JobSubmitted:
     plan: ExecutionPlan
 
 
+@dataclasses.dataclass(frozen=True)
+class ReviveOffers:
+    """Push-mode dispatch tick (ref scheduler_server/event_loop.rs:35-169:
+    SchedulerServerEvent::ReviveOffers)."""
+
+    n: int = 1
+
+
 class QueryStageScheduler(EventAction):
     """The stage DAG state machine (ref query_stage_scheduler.rs:40-473)."""
 
@@ -95,19 +103,24 @@ class QueryStageScheduler(EventAction):
 
     def on_receive(self, event):
         s = self.server
+        if isinstance(event, ReviveOffers):
+            s._offer_resources()
+            return None
         if isinstance(event, JobSubmitted):
             s._generate_stages(event.job_id, event.plan)
-            return None
-        if isinstance(event, StageFinished):
+        elif isinstance(event, StageFinished):
             s._on_stage_finished(event.job_id, event.stage_id)
-            return None
-        if isinstance(event, JobFinished):
+        elif isinstance(event, JobFinished):
             s._on_job_finished(event.job_id)
-            return None
-        if isinstance(event, JobFailed):
+        elif isinstance(event, JobFailed):
             s._on_job_failed(event.job_id, event.error)
+        else:
+            log.warning("unknown scheduler event %r", event)
             return None
-        log.warning("unknown scheduler event %r", event)
+        # push mode: every stage/job event can unlock work — re-offer (ref
+        # query_stage_scheduler.rs:403-408)
+        if s.policy == TaskSchedulingPolicy.PUSH_STAGED:
+            return ReviveOffers()
         return None
 
 
@@ -119,7 +132,15 @@ class SchedulerServer:
         self,
         provider: TableProvider,
         config: BallistaConfig | None = None,
+        state_backend=None,
+        namespace: str = "default",
+        policy: TaskSchedulingPolicy = TaskSchedulingPolicy.PULL_STAGED,
     ):
+        """``state_backend``: a
+        :class:`ballista_tpu.scheduler.state_backend.StateBackendClient`;
+        when given, executors/sessions/jobs/stage-plans write through to it
+        and a new SchedulerServer over the same backend recovers them (ref
+        persistent_state.rs:85-181 + the restart test :401-525)."""
         self.provider = provider
         self.config = config or BallistaConfig()
         self.codec = BallistaCodec(provider=provider)
@@ -127,12 +148,73 @@ class SchedulerServer:
         self.executor_manager = ExecutorManager()
         self.jobs: dict[str, JobInfo] = {}
         self.sessions: dict[str, BallistaConfig] = {}
+        self.policy = policy
+        # push mode: the scheduler dials each executor's gRPC back at
+        # registration (ref grpc.rs:180-192) and launches tasks through it
+        self.executor_clients: dict[str, object] = {}
+        self._executor_channels: dict[str, object] = {}
         self._lock = threading.RLock()
+        self.state = None
+        if state_backend is not None:
+            from ballista_tpu.scheduler.persistent_state import (
+                PersistentSchedulerState,
+            )
+
+            self.state = PersistentSchedulerState(
+                state_backend, namespace, self.codec
+            )
+            self._recover_state()
         self.event_loop = EventLoop("query-stage", QueryStageScheduler(self))
         self.event_loop.start()
         import time as _time
 
         self.start_time = _time.time()
+
+    def _recover_state(self) -> None:
+        """Rebuild in-memory state from the backend on restart (ref
+        persistent_state.rs init :85-181)."""
+        for em in self.state.load_executors():
+            self.executor_manager.save_executor_metadata(em)
+        for sid, settings in self.state.load_sessions().items():
+            try:
+                self.sessions[sid] = (
+                    BallistaConfig(settings) if settings else self.config
+                )
+            except Exception:  # noqa: BLE001 — stale/unknown keys
+                self.sessions[sid] = self.config
+        for rec in self.state.load_jobs():
+            job = JobInfo(
+                job_id=rec["job_id"],
+                session_id=rec["session_id"],
+                status=rec["status"],
+                error=rec.get("error", ""),
+                final_stage_id=rec.get("final_stage_id", 0),
+            )
+            job.dependencies = {
+                int(k): set(v)
+                for k, v in rec.get("dependencies", {}).items()
+            }
+            job.completed_locations = self.state.locations_from_json(
+                rec.get("locations", [])
+            )
+            plans = self.state.load_stage_plans(job.job_id)
+            for stage_id, plan in plans.items():
+                job.stages[stage_id] = QueryStage(
+                    job.job_id, stage_id, plan
+                )
+            if job.status in ("queued", "running"):
+                # tasks in flight died with the old scheduler; fail loudly
+                # rather than dangle (running StageManager state is not
+                # persisted, matching the reference)
+                job.status = "failed"
+                job.error = "scheduler restarted while job was in flight"
+                self.state.save_job(job)
+            self.jobs[job.job_id] = job
+        if self.jobs:
+            log.info(
+                "recovered %d jobs, %d sessions from state backend",
+                len(self.jobs), len(self.sessions),
+            )
 
     # -- session management (ref grpc.rs:350-374) ----------------------------
     def get_or_create_session(
@@ -149,7 +231,13 @@ class SchedulerServer:
             self.sessions[new_id] = (
                 BallistaConfig(settings) if settings else self.config
             )
+            if self.state is not None:
+                self.state.save_session(new_id, settings or {})
             return new_id
+
+    def persist_executor(self, em: ExecutorMetadata) -> None:
+        if self.state is not None:
+            self.state.save_executor_metadata(em)
 
     # -- query submission ----------------------------------------------------
     def submit_sql(self, sql: str, session_id: str) -> str:
@@ -176,7 +264,10 @@ class SchedulerServer:
     def submit_physical(self, physical: ExecutionPlan, session_id: str) -> str:
         job_id = generate_job_id()
         with self._lock:
-            self.jobs[job_id] = JobInfo(job_id=job_id, session_id=session_id)
+            job = JobInfo(job_id=job_id, session_id=session_id)
+            self.jobs[job_id] = job
+            if self.state is not None:
+                self.state.save_job(job)
         self.event_loop.post(JobSubmitted(job_id, physical))
         return job_id
 
@@ -199,6 +290,14 @@ class SchedulerServer:
         self.stage_manager.add_final_stage(job_id, job.final_stage_id)
         self.stage_manager.add_stages_dependency(job_id, deps)
         job.status = "running"
+        if self.state is not None:
+            # write-through: stage plans + job record (ref
+            # persistent_state.rs save_stage_plan :183-324)
+            for stage in stages:
+                self.state.save_stage_plan(
+                    job_id, stage.stage_id, stage.plan
+                )
+            self.state.save_job(job)
         self._submit_stage(job_id, job.final_stage_id, set())
 
     def _submit_stage(
@@ -304,6 +403,8 @@ class SchedulerServer:
             flat.extend(part)
         job.completed_locations = flat
         job.status = "completed"
+        if self.state is not None:
+            self.state.save_job(job)
         log.info("job %s completed (%d partitions)", job_id, len(flat))
 
     def _on_job_failed(self, job_id: str, error: str) -> None:
@@ -312,6 +413,8 @@ class SchedulerServer:
             return
         job.status = "failed"
         job.error = error
+        if self.state is not None:
+            self.state.save_job(job)
         log.error("job %s failed: %s", job_id, error)
 
     # -- task handout (pull mode; ref grpc.rs:121-147) -----------------------
@@ -426,6 +529,7 @@ class SchedulerGrpcServicer:
         )
         self.s.executor_manager.save_executor_metadata(em)
         self.s.executor_manager.save_executor_heartbeat(meta.id)
+        self.s.persist_executor(em)
         if self.s.executor_manager.get_executor_data(meta.id) is None:
             self.s.executor_manager.save_executor_data(
                 ExecutorData(
@@ -455,6 +559,7 @@ class SchedulerGrpcServicer:
         )
         self.s.executor_manager.save_executor_metadata(em)
         self.s.executor_manager.save_executor_heartbeat(meta.id)
+        self.s.persist_executor(em)
         self.s.executor_manager.save_executor_data(
             ExecutorData(
                 meta.id, em.specification.task_slots, em.specification.task_slots

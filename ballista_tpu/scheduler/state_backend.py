@@ -1,0 +1,128 @@
+"""Pluggable KV state backends for scheduler persistence.
+
+Mirrors the reference's ``StateBackendClient`` trait (ref
+ballista/rust/scheduler/src/state/backend/mod.rs:53-94: get,
+get_from_prefix, put, lock, watch) with two implementations standing in
+for the reference's sled (backend/standalone.rs:31-180) and etcd
+(backend/etcd.rs:32-196):
+
+- :class:`MemoryBackend` — in-process dict (tests / ephemeral schedulers);
+- :class:`SqliteBackend` — a file-backed store, the embedded-DB analogue
+  of sled in this Python runtime (sqlite ships in the stdlib and gives
+  the same durability contract: survive a scheduler restart on one node).
+
+Keys follow the reference's scheme: ``/ballista/<namespace>/...``
+(persistent_state.rs:326-352).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Iterator
+
+
+class StateBackendClient:
+    """KV-store interface (ref backend/mod.rs:53-94)."""
+
+    def get(self, key: str) -> bytes | None:
+        raise NotImplementedError
+
+    def get_from_prefix(self, prefix: str) -> list[tuple[str, bytes]]:
+        raise NotImplementedError
+
+    def put(self, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def lock(self):
+        """Global scheduler lock (ref etcd.rs:85 `/ballista_global_lock`,
+        persistent_state.rs:313-319 global lock around each save)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryBackend(StateBackendClient):
+    def __init__(self) -> None:
+        self._data: dict[str, bytes] = {}
+        self._lock = threading.RLock()
+
+    def get(self, key: str) -> bytes | None:
+        with self._lock:
+            return self._data.get(key)
+
+    def get_from_prefix(self, prefix: str) -> list[tuple[str, bytes]]:
+        with self._lock:
+            return sorted(
+                (k, v) for k, v in self._data.items() if k.startswith(prefix)
+            )
+
+    def put(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._data[key] = bytes(value)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def lock(self):
+        return self._lock
+
+
+class SqliteBackend(StateBackendClient):
+    """File-backed KV store (the sled analogue, ref
+    backend/standalone.rs:31-180). One table, BLOB values, WAL mode so a
+    crashed scheduler's last committed writes survive."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock = threading.RLock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv ("
+                "key TEXT PRIMARY KEY, value BLOB NOT NULL)"
+            )
+            self._conn.commit()
+
+    def get(self, key: str) -> bytes | None:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT value FROM kv WHERE key = ?", (key,)
+            ).fetchone()
+        return None if row is None else bytes(row[0])
+
+    def get_from_prefix(self, prefix: str) -> list[tuple[str, bytes]]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT key, value FROM kv WHERE key >= ? AND key < ? "
+                "ORDER BY key",
+                (prefix, prefix + "￿"),
+            ).fetchall()
+        return [(k, bytes(v)) for k, v in rows]
+
+    def put(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO kv (key, value) VALUES (?, ?) "
+                "ON CONFLICT(key) DO UPDATE SET value = excluded.value",
+                (key, sqlite3.Binary(value)),
+            )
+            self._conn.commit()
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE key = ?", (key,))
+            self._conn.commit()
+
+    def lock(self):
+        return self._lock
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

@@ -670,6 +670,7 @@ class BallistaCodec:
                 projection=plan.projection or [],
                 has_projection=plan.projection is not None,
                 partitions=plan.partitions,
+                filters=[expr_to_proto(e) for e in plan.predicates],
             )
         )
 
@@ -813,7 +814,10 @@ class BallistaCodec:
                 n.path, schema, n.has_header, n.delimiter or ",",
                 projection, n.partitions or 1,
             )
-        return ParquetScanExec(n.path, schema, projection, n.partitions or 1)
+        return ParquetScanExec(
+            n.path, schema, projection, n.partitions or 1,
+            predicates=[expr_from_proto(e) for e in n.filters],
+        )
 
 
 def loc_to_proto(loc) -> pb.PartitionLocation:

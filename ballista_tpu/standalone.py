@@ -35,11 +35,14 @@ class StandaloneCluster:
         config: BallistaConfig | None = None,
         concurrent_tasks: int = 4,
         provider: TableProvider | None = None,
+        state_backend=None,
     ) -> "StandaloneCluster":
         tmp = tempfile.TemporaryDirectory(prefix="ballista-standalone-")
         work_dir = tmp.name
 
-        scheduler = SchedulerServer(provider=provider, config=config)
+        scheduler = SchedulerServer(
+            provider=provider, config=config, state_backend=state_backend
+        )
         grpc_server, scheduler_port = start_scheduler_grpc(
             scheduler, "127.0.0.1", 0
         )

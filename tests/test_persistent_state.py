@@ -1,0 +1,139 @@
+"""Persistent scheduler state (VERDICT r2 Next#5).
+
+Mirrors the reference's restart-recovery test shape
+(persistent_state.rs:401-525): save executors/sessions/jobs/stage plans
+through a StateBackendClient, construct a NEW SchedulerServer over the
+same backend, and assert the state is recovered.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from ballista_tpu.scheduler.state_backend import MemoryBackend, SqliteBackend
+from tests.conftest import CPU_MESH_ENV
+
+
+@pytest.mark.parametrize("make", [MemoryBackend, None])
+def test_backend_kv_contract(tmp_path, make):
+    b = make() if make else SqliteBackend(str(tmp_path / "state.db"))
+    assert b.get("/x") is None
+    b.put("/ballista/default/jobs/a", b"1")
+    b.put("/ballista/default/jobs/b", b"2")
+    b.put("/ballista/default/sessions/s", b"3")
+    assert b.get("/ballista/default/jobs/a") == b"1"
+    assert b.get_from_prefix("/ballista/default/jobs") == [
+        ("/ballista/default/jobs/a", b"1"),
+        ("/ballista/default/jobs/b", b"2"),
+    ]
+    b.put("/ballista/default/jobs/a", b"9")  # upsert
+    assert b.get("/ballista/default/jobs/a") == b"9"
+    b.delete("/ballista/default/jobs/a")
+    assert b.get("/ballista/default/jobs/a") is None
+    b.close()
+
+
+def test_sqlite_survives_reopen(tmp_path):
+    path = str(tmp_path / "state.db")
+    b = SqliteBackend(path)
+    b.put("/k", b"v")
+    b.close()
+    b2 = SqliteBackend(path)
+    assert b2.get("/k") == b"v"
+    b2.close()
+
+
+def test_scheduler_restart_recovery(tmp_path):
+    """Full restart cycle through a real standalone cluster: run a job to
+    completion over a sqlite backend, build a fresh SchedulerServer over
+    the same backend, and verify the completed job (status, result
+    locations, stage plans) and session come back."""
+    script = rf"""
+import numpy as np
+import pyarrow as pa
+
+from ballista_tpu.client.context import BallistaContext
+from ballista_tpu.scheduler.server import SchedulerServer
+from ballista_tpu.scheduler.state_backend import SqliteBackend
+
+path = {str(tmp_path / 'sched.db')!r}
+backend = SqliteBackend(path)
+
+from ballista_tpu.standalone import StandaloneCluster
+from ballista_tpu.config import BallistaConfig
+
+cfg = BallistaConfig().with_setting("ballista.shuffle.partitions", "2")
+cluster = StandaloneCluster.start(cfg, 4, state_backend=backend)
+ctx = BallistaContext(f"localhost:{{cluster.scheduler_port}}", cfg)
+ctx._standalone_cluster = cluster
+cluster.attach_provider(ctx)
+
+n = 4000
+t = pa.table({{"k": pa.array((np.arange(n) % 9).astype(np.int64)),
+              "v": pa.array(np.random.default_rng(0).uniform(0, 1, n))}})
+ctx.register_table("t", t)
+res = ctx.sql("select k, sum(v) as s from t group by k order by k").collect()
+assert res.num_rows == 9
+job_id = next(iter(cluster.scheduler.jobs))
+old_job = cluster.scheduler.jobs[job_id]
+assert old_job.status == "completed"
+n_locs = len(old_job.completed_locations)
+assert n_locs > 0
+session_id = ctx.session_id
+cluster.poll_loop.stop()
+cluster.scheduler.shutdown()
+cluster.scheduler_grpc.stop(grace=None)
+
+# ---- restart: a brand-new SchedulerServer over the same backend ----
+recovered = SchedulerServer(provider=ctx, state_backend=SqliteBackend(path))
+job = recovered.jobs[job_id]
+assert job.status == "completed", job.status
+assert len(job.completed_locations) == n_locs
+assert job.completed_locations[0].path
+assert session_id in recovered.sessions
+# stage plans decode back into executable fragments
+assert job.stages, "stage plans must be recovered"
+for stage in job.stages.values():
+    assert stage.plan.display()
+# GetJobStatus on the recovered scheduler serves the completed locations
+st = recovered.job_status_proto(job_id)
+assert st.WhichOneof("status") == "completed"
+assert len(st.completed.partition_location) == n_locs
+recovered.shutdown()
+print("RECOVERY-OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        env=CPU_MESH_ENV,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "RECOVERY-OK" in proc.stdout
+
+
+def test_inflight_job_fails_loudly_on_restart(tmp_path):
+    """A job that was queued/running when the scheduler died must come
+    back failed (running task state is not persisted, matching the
+    reference), not dangle forever."""
+    from ballista_tpu.scheduler.server import JobInfo
+    from ballista_tpu.scheduler.persistent_state import (
+        PersistentSchedulerState,
+    )
+    from ballista_tpu.scheduler.server import SchedulerServer
+
+    backend = SqliteBackend(str(tmp_path / "s.db"))
+    st = PersistentSchedulerState(backend, "default", None)
+    job = JobInfo(job_id="abc1234", session_id="s1", status="running")
+    st.save_job(job)
+    st.save_session("s1", {})
+
+    recovered = SchedulerServer(provider=None, state_backend=backend)
+    j = recovered.jobs["abc1234"]
+    assert j.status == "failed"
+    assert "restart" in j.error
+    recovered.shutdown()

@@ -1,0 +1,144 @@
+"""Scan hygiene (VERDICT r2 Weak#4/#5): CSV parses once per operator and
+parquet row groups prune on min/max statistics with pushed-down predicates.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+from ballista_tpu.exec.context import TpuContext
+
+
+def test_csv_scan_parses_file_once(tmp_path):
+    import pyarrow.csv as pacsv
+
+    from ballista_tpu.columnar.arrow_interop import schema_from_arrow
+    from ballista_tpu.exec.base import TaskContext
+    from ballista_tpu.exec.scan import CsvScanExec
+
+    n = 10_000
+    t = pa.table(
+        {
+            "a": pa.array(np.arange(n, dtype=np.int64)),
+            "b": pa.array(np.random.default_rng(0).uniform(0, 1, n)),
+        }
+    )
+    path = tmp_path / "t.csv"
+    pacsv.write_csv(t, path)
+
+    scan = CsvScanExec(str(path), schema_from_arrow(t.schema), partitions=4)
+    calls = {"n": 0}
+    orig = pacsv.read_csv
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    pacsv.read_csv = counting
+    try:
+        ctx = TaskContext()
+        rows = 0
+        for p in range(4):
+            for b in scan.execute(p, ctx):
+                rows += int(np.asarray(b.valid).sum())
+    finally:
+        pacsv.read_csv = orig
+    assert rows == n
+    assert calls["n"] == 1, f"CSV parsed {calls['n']} times for 4 partitions"
+
+
+@pytest.fixture()
+def sorted_parquet(tmp_path):
+    n = 50_000
+    t = pa.table(
+        {
+            "k": pa.array(np.arange(n, dtype=np.int64)),  # sorted
+            "v": pa.array(np.random.default_rng(1).uniform(0, 1, n)),
+        }
+    )
+    path = tmp_path / "t.parquet"
+    papq.write_table(t, path, row_group_size=5_000)  # 10 row groups
+    return str(path), t
+
+
+def test_parquet_row_group_pruning(sorted_parquet):
+    path, t = sorted_parquet
+    ctx = TpuContext()
+    ctx.register_parquet("t", path)
+    df = ctx.sql("SELECT COUNT(*) AS c, SUM(v) AS s FROM t WHERE k >= 45000")
+    phys = ctx.create_physical_plan(df.logical)
+    out = df.collect().to_pandas()
+    want = t.to_pandas().query("k >= 45000")
+    assert int(out.c[0]) == len(want)
+    np.testing.assert_allclose(out.s[0], want.v.sum(), rtol=1e-9)
+
+    # the scan must have skipped the 9 row groups that cannot match
+    def find_scan(p):
+        from ballista_tpu.exec.scan import ParquetScanExec
+
+        if isinstance(p, ParquetScanExec):
+            return p
+        for c in p.children():
+            s = find_scan(c)
+            if s is not None:
+                return s
+        return None
+
+    scan = find_scan(phys)
+    assert scan is not None and scan.predicates
+    ctx2 = TpuContext()
+    from ballista_tpu.exec.base import TaskContext
+
+    rows = 0
+    tctx = TaskContext()
+    for p in range(scan.partitions):
+        for b in scan.execute(p, tctx):
+            rows += int(np.asarray(b.valid).sum())
+    pruned = scan.metrics.counters.get("row_groups_pruned", 0)
+    assert pruned == 9, f"expected 9 pruned groups, got {pruned}"
+    assert rows == 5_000  # only the last group read
+
+
+def test_pruning_never_loses_rows(sorted_parquet):
+    """Predicates the stats can't decide (e.g. on an unsorted column) must
+    keep every group; results still exact."""
+    path, t = sorted_parquet
+    ctx = TpuContext()
+    ctx.register_parquet("t", path)
+    out = ctx.sql(
+        "SELECT COUNT(*) AS c FROM t WHERE v < 0.25"
+    ).collect().to_pandas()
+    want = (t.to_pandas().v < 0.25).sum()
+    assert int(out.c[0]) == int(want)
+
+
+def test_pruning_disabled_by_config(sorted_parquet):
+    from ballista_tpu.config import BallistaConfig
+
+    path, _ = sorted_parquet
+    cfg = BallistaConfig().with_setting("ballista.parquet.pruning", "false")
+    ctx = TpuContext(cfg)
+    ctx.register_parquet("t", path)
+    df = ctx.sql("SELECT COUNT(*) AS c FROM t WHERE k >= 45000")
+    phys = ctx.create_physical_plan(df.logical)
+    from ballista_tpu.exec.base import TaskContext
+    from ballista_tpu.exec.scan import ParquetScanExec
+
+    def find_scan(p):
+        if isinstance(p, ParquetScanExec):
+            return p
+        for c in p.children():
+            s = find_scan(c)
+            if s is not None:
+                return s
+        return None
+
+    scan = find_scan(phys)
+    tctx = TaskContext(config=cfg)
+    rows = 0
+    for p in range(scan.partitions):
+        for b in scan.execute(p, tctx):
+            rows += int(np.asarray(b.valid).sum())
+    assert rows == 50_000  # nothing pruned
+    assert scan.metrics.counters.get("row_groups_pruned", 0) == 0
