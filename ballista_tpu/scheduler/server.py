@@ -20,7 +20,7 @@ import random
 import string
 import threading
 
-from ballista_tpu.config import BallistaConfig
+from ballista_tpu.config import BallistaConfig, TaskSchedulingPolicy
 from ballista_tpu.distributed_plan import (
     DistributedPlanner,
     QueryStage,
