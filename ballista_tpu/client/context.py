@@ -69,13 +69,21 @@ class BallistaContext(TpuContext):
         cls,
         config: BallistaConfig | None = None,
         concurrent_tasks: int = 4,
+        policy=None,
     ) -> "BallistaContext":
         """Boot an in-proc scheduler + executor over localhost gRPC/Flight
         (ref context.rs:137-207 + scheduler/standalone.rs +
-        executor/standalone.rs) — full cluster semantics in one process."""
+        executor/standalone.rs) — full cluster semantics in one process.
+        ``policy`` selects pull- vs push-staged task scheduling
+        (ref scheduler/src/main.rs:87-95 ``--scheduler-policy``)."""
+        from ballista_tpu.config import TaskSchedulingPolicy
         from ballista_tpu.standalone import StandaloneCluster
 
-        cluster = StandaloneCluster.start(config, concurrent_tasks)
+        cluster = StandaloneCluster.start(
+            config,
+            concurrent_tasks,
+            policy=policy or TaskSchedulingPolicy.PULL_STAGED,
+        )
         ctx = cls(f"localhost:{cluster.scheduler_port}", config)
         ctx._standalone_cluster = cluster
         # the in-proc scheduler/executor resolve memory tables through the

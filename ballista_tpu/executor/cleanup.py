@@ -1,0 +1,73 @@
+"""Shuffle-data housekeeping: TTL-based work_dir garbage collection.
+
+ref ballista/rust/executor/src/main.rs:193-257 — ``clean_shuffle_data_loop``
+runs every ``job_data_clean_up_interval_seconds``; a job directory whose
+most recent modification is older than ``job_data_ttl_seconds`` is deleted
+(the scheduler keeps no reference to it past job completion + client fetch).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+
+def _newest_mtime(path: str) -> float:
+    """Most recent mtime in the directory tree (ref main.rs:226-243:
+    max of all file/dir modification times)."""
+    newest = os.path.getmtime(path)
+    for root, dirs, files in os.walk(path):
+        for name in dirs + files:
+            try:
+                newest = max(newest, os.path.getmtime(os.path.join(root, name)))
+            except OSError:
+                pass
+    return newest
+
+
+def clean_shuffle_data(work_dir: str, ttl_seconds: float) -> list[str]:
+    """Delete per-job shuffle directories idle for longer than the TTL.
+    Returns the deleted job ids (ref main.rs:205-224)."""
+    deleted: list[str] = []
+    if not os.path.isdir(work_dir):
+        return deleted
+    now = time.time()
+    for entry in os.listdir(work_dir):
+        job_dir = os.path.join(work_dir, entry)
+        if not os.path.isdir(job_dir):
+            continue
+        try:
+            if now - _newest_mtime(job_dir) > ttl_seconds:
+                shutil.rmtree(job_dir, ignore_errors=True)
+                deleted.append(entry)
+        except OSError as e:
+            log.warning("cleanup of %s failed: %s", job_dir, e)
+    if deleted:
+        log.info("cleaned %d expired job dirs: %s", len(deleted), deleted)
+    return deleted
+
+
+def start_cleanup_loop(
+    work_dir: str,
+    ttl_seconds: float,
+    interval_seconds: float,
+    stop: threading.Event | None = None,
+) -> tuple[threading.Thread, threading.Event]:
+    """Background TTL sweep (ref main.rs:193-203). Returns (thread, stop)."""
+    stop = stop or threading.Event()
+
+    def loop() -> None:
+        while not stop.wait(interval_seconds):
+            try:
+                clean_shuffle_data(work_dir, ttl_seconds)
+            except Exception:  # noqa: BLE001
+                log.exception("shuffle cleanup sweep failed")
+
+    t = threading.Thread(target=loop, daemon=True, name="shuffle-cleanup")
+    t.start()
+    return t, stop

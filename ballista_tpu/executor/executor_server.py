@@ -29,7 +29,15 @@ from ballista_tpu.scheduler.rpc import (
 
 log = logging.getLogger(__name__)
 
-HEARTBEAT_INTERVAL_S = 60.0  # ref executor_server.rs:273-283
+# The scheduler's liveness window defaults to 60s (executor_manager.rs:69-77);
+# heartbeating at a quarter of it keeps a healthy margin (the reference's 60s
+# interval against a 60s window has zero margin).
+HEARTBEAT_INTERVAL_S = 15.0
+
+# Every control RPC carries a deadline: a half-open connection (scheduler
+# migrated, NAT dropped without RST) must time out and retry on the next
+# loop tick, never wedge the heartbeat/runner thread forever.
+RPC_TIMEOUT_S = 10.0
 
 
 class ExecutorServer:
@@ -84,7 +92,8 @@ class ExecutorServer:
         self._channel = grpc.insecure_channel(self.scheduler_addr)
         self._sched = scheduler_stub(self._channel)
         self._sched.RegisterExecutor(
-            pb.RegisterExecutorParams(metadata=self._metadata())
+            pb.RegisterExecutorParams(metadata=self._metadata()),
+            timeout=RPC_TIMEOUT_S,
         )
 
         hb = threading.Thread(
@@ -114,9 +123,31 @@ class ExecutorServer:
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval_s):
             try:
-                self._sched.HeartBeatFromExecutor(
-                    pb.HeartBeatParams(executor_id=self.executor.executor_id)
+                result = self._sched.HeartBeatFromExecutor(
+                    pb.HeartBeatParams(executor_id=self.executor.executor_id),
+                    timeout=RPC_TIMEOUT_S,
                 )
+                if result.reregister:
+                    # the scheduler expired us (or restarted); it has reset
+                    # every task it launched here back to PENDING, so our
+                    # queued (not yet started) copies must be dropped before
+                    # re-announcing — otherwise the fresh slot grant lets
+                    # the scheduler stack a second full load on top
+                    dropped = 0
+                    try:
+                        while True:
+                            self._queue.get_nowait()
+                            dropped += 1
+                    except queue.Empty:
+                        pass
+                    log.info(
+                        "scheduler requested re-registration "
+                        "(dropped %d queued tasks)", dropped,
+                    )
+                    self._sched.RegisterExecutor(
+                        pb.RegisterExecutorParams(metadata=self._metadata()),
+                        timeout=RPC_TIMEOUT_S,
+                    )
             except grpc.RpcError as e:
                 log.warning("heartbeat failed: %s", e)
 
@@ -142,7 +173,8 @@ class ExecutorServer:
                     pb.UpdateTaskStatusParams(
                         executor_id=self.executor.executor_id,
                         task_status=[status],
-                    )
+                    ),
+                    timeout=RPC_TIMEOUT_S,
                 )
             except grpc.RpcError as e:
                 log.warning("UpdateTaskStatus failed: %s", e)

@@ -1,0 +1,132 @@
+"""Scheduler process entrypoint: ``python -m ballista_tpu.scheduler``.
+
+ref ballista/rust/scheduler/src/main.rs:65-198 — parse the flag/env config
+tier, pick the state backend (in-memory or sqlite, standing in for the
+reference's sled/etcd pair), start the SchedulerGrpc service and the REST
+``/state`` API, and wait for a signal.
+
+Flags mirror the reference's scheduler config spec; every flag also reads a
+``BALLISTA_SCHEDULER_<NAME>`` environment default (configure_me behavior).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import threading
+
+from ballista_tpu.config import BallistaConfig, TaskSchedulingPolicy
+
+log = logging.getLogger("ballista_tpu.scheduler")
+
+
+def _env(name: str, default):
+    return os.environ.get(f"BALLISTA_SCHEDULER_{name.upper()}", default)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m ballista_tpu.scheduler",
+        description="ballista-tpu scheduler process",
+    )
+    p.add_argument("--bind-host", default=_env("bind_host", "0.0.0.0"))
+    p.add_argument(
+        "--bind-port", type=int, default=int(_env("bind_port", 50050))
+    )
+    p.add_argument(
+        "--rest-port",
+        type=int,
+        default=int(_env("rest_port", 0)),
+        help="REST /state + UI port; 0 disables "
+        "(the reference multiplexes gRPC+REST on one port, main.rs:136-166)",
+    )
+    p.add_argument(
+        "--scheduler-policy",
+        default=_env("scheduler_policy", "pull-staged"),
+        choices=["pull-staged", "push-staged"],
+    )
+    p.add_argument(
+        "--namespace", default=_env("namespace", "ballista"),
+        help="state-backend key prefix (ref main.rs:74-78)",
+    )
+    p.add_argument(
+        "--state-backend",
+        default=_env("state_backend", "memory"),
+        choices=["memory", "sqlite"],
+        help="standalone(sled)->memory, etcd->sqlite equivalents",
+    )
+    p.add_argument(
+        "--state-path",
+        default=_env("state_path", "ballista-scheduler-state.db"),
+        help="sqlite file path when --state-backend=sqlite",
+    )
+    p.add_argument(
+        "--executor-timeout-seconds",
+        type=float,
+        default=float(_env("executor_timeout_seconds", 60)),
+    )
+    p.add_argument("--log-level", default=_env("log_level", "INFO"))
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    from ballista_tpu.scheduler.server import (
+        SchedulerServer,
+        start_scheduler_grpc,
+    )
+    from ballista_tpu.scheduler.state_backend import (
+        MemoryBackend,
+        SqliteBackend,
+    )
+
+    backend = (
+        SqliteBackend(args.state_path)
+        if args.state_backend == "sqlite"
+        else MemoryBackend()
+    )
+    server = SchedulerServer(
+        provider=None,
+        config=BallistaConfig(),
+        state_backend=backend,
+        namespace=args.namespace,
+        policy=TaskSchedulingPolicy.parse(args.scheduler_policy),
+        executor_timeout_s=args.executor_timeout_seconds,
+    )
+    grpc_server, port = start_scheduler_grpc(
+        server, args.bind_host, args.bind_port
+    )
+    log.info(
+        "scheduler: gRPC on %s:%d, policy=%s, backend=%s",
+        args.bind_host, port, args.scheduler_policy, args.state_backend,
+    )
+    rest = None
+    if args.rest_port:
+        from ballista_tpu.scheduler.rest import start_rest_server
+
+        rest, rest_port = start_rest_server(
+            server, args.bind_host, args.rest_port
+        )
+        log.info("REST /state on %s:%d", args.bind_host, rest_port)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    log.info("shutting down")
+    if rest is not None:
+        rest.shutdown()
+    grpc_server.stop(grace=1)
+    server.shutdown()
+    backend.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -70,9 +70,24 @@ class ExecutorManager:
         with self._lock:
             return self._data.get(executor_id)
 
-    def get_available_executors_data(self) -> list[ExecutorData]:
+    def tracked_executors(self) -> set[str]:
+        """Executors with registered slot accounting (candidates for
+        expiry checks)."""
+        with self._lock:
+            return set(self._data.keys())
+
+    def remove_executor(self, executor_id: str) -> None:
+        """Drop a dead executor from scheduling (metadata is kept — already-
+        written shuffle locations still reference its host)."""
+        with self._lock:
+            self._data.pop(executor_id, None)
+            self._heartbeats.pop(executor_id, None)
+
+    def get_available_executors_data(
+        self, timeout: float = DEFAULT_EXECUTOR_TIMEOUT_SECONDS
+    ) -> list[ExecutorData]:
         """Alive executors with free slots, most-free first (ref :121-135)."""
-        alive = self.get_alive_executors()
+        alive = self.get_alive_executors(timeout)
         with self._lock:
             out = [
                 ExecutorData(

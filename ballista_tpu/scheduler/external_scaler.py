@@ -1,0 +1,72 @@
+"""KEDA external-scaler gRPC service.
+
+ref ballista/rust/scheduler/src/scheduler_server/external_scaler.rs:31-66:
+KEDA polls ``IsActive`` (scale 0<->1 on whether any task is running) and
+``GetMetrics`` (saturate the HPA while work exists). Served under KEDA's
+fixed service path ``externalscaler.ExternalScaler`` (keda.proto) so a
+stock KEDA `ScaledObject` pointing at the scheduler works unchanged.
+"""
+
+from __future__ import annotations
+
+from ballista_tpu.proto import pb
+from ballista_tpu.scheduler.rpc import add_service
+
+EXTERNAL_SCALER_SERVICE = "externalscaler.ExternalScaler"
+
+EXTERNAL_SCALER_METHODS = {
+    "IsActive": (pb.ScaledObjectRef, pb.IsActiveResponse),
+    "GetMetricSpec": (pb.ScaledObjectRef, pb.GetMetricSpecResponse),
+    "GetMetrics": (pb.GetMetricsRequest, pb.GetMetricsResponse),
+}
+
+INFLIGHT_TASKS_METRIC_NAME = "inflight_tasks"
+
+
+class ExternalScalerServicer:
+    """Implements KEDA's three-RPC contract over the scheduler state."""
+
+    def __init__(self, server):
+        self.s = server
+
+    def IsActive(self, request: pb.ScaledObjectRef, context):
+        # ref :34-41 checks has_running_tasks(); counting PENDING too is a
+        # deliberate fix — scaled to zero, no task can ever be RUNNING, so
+        # the reference's signal can never trigger the 0->1 scale-up
+        return pb.IsActiveResponse(
+            result=self.s.stage_manager.inflight_tasks() > 0
+        )
+
+    def GetMetricSpec(self, request: pb.ScaledObjectRef, context):
+        # ref :43-53 — one metric, target 1 task per replica
+        return pb.GetMetricSpecResponse(
+            metricSpecs=[
+                pb.MetricSpec(
+                    metricName=INFLIGHT_TASKS_METRIC_NAME, targetSize=1
+                )
+            ]
+        )
+
+    def GetMetrics(self, request: pb.GetMetricsRequest, context):
+        # ref :55-66 reports a huge constant to saturate the HPA while work
+        # exists; reporting the actual inflight count gives KEDA a real
+        # signal and the same saturating behavior for large jobs
+        return pb.GetMetricsResponse(
+            metricValues=[
+                pb.MetricValue(
+                    metricName=INFLIGHT_TASKS_METRIC_NAME,
+                    metricValue=self.s.stage_manager.inflight_tasks(),
+                )
+            ]
+        )
+
+
+def add_external_scaler(grpc_server, scheduler_server) -> None:
+    """Attach the KEDA service to an already-running gRPC server (the
+    reference multiplexes it on the scheduler's main port, main.rs:136-166)."""
+    add_service(
+        grpc_server,
+        EXTERNAL_SCALER_SERVICE,
+        EXTERNAL_SCALER_METHODS,
+        ExternalScalerServicer(scheduler_server),
+    )

@@ -1,0 +1,150 @@
+"""Scheduler REST API + status UI.
+
+ref ballista/rust/scheduler/src/api/{mod,handlers}.rs — ``GET /api/state``
+returns the executor roster + uptime as JSON (handlers.rs:34-57); the
+scheduler also serves a human status page (the reference ships a yew/WASM
+UI under ballista/ui; here a single self-contained HTML page renders the
+same state from ``/api/state``).
+
+Implemented over the stdlib ThreadingHTTPServer — the REST tier is a thin
+read-only view of :class:`SchedulerServer`, not a data path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+log = logging.getLogger(__name__)
+
+BALLISTA_VERSION = "0.6.0-tpu"
+
+_UI_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ballista-tpu scheduler</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; color: #1a1a2e; }
+ h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
+ table { border-collapse: collapse; min-width: 40rem; }
+ th, td { text-align: left; padding: .35rem .8rem; border-bottom: 1px solid #ddd; }
+ th { background: #f4f4f8; }
+ .muted { color: #777; font-size: .85rem; }
+</style></head>
+<body>
+<h1>ballista-tpu scheduler</h1>
+<div class="muted" id="meta"></div>
+<h2>Executors</h2>
+<table id="executors"><thead><tr>
+ <th>id</th><th>host</th><th>flight port</th><th>slots (free/total)</th><th>last seen</th>
+</tr></thead><tbody></tbody></table>
+<h2>Jobs</h2>
+<table id="jobs"><thead><tr>
+ <th>job id</th><th>status</th><th>stages</th><th>error</th>
+</tr></thead><tbody></tbody></table>
+<script>
+// textContent only — job errors echo user SQL fragments, never as HTML
+function row(tbody, cells) {
+  const tr = document.createElement('tr');
+  for (const c of cells) {
+    const td = document.createElement('td');
+    td.textContent = c;
+    tr.appendChild(td);
+  }
+  tbody.appendChild(tr);
+}
+async function refresh() {
+  const r = await fetch('api/state'); const s = await r.json();
+  document.getElementById('meta').textContent =
+    `version ${s.version} — up ${Math.round(s.uptime_seconds)}s — policy ${s.policy}`;
+  const ex = document.querySelector('#executors tbody'); ex.innerHTML = '';
+  for (const e of s.executors) {
+    row(ex, [e.id, e.host, e.port,
+      `${e.available_task_slots ?? '-'} / ${e.total_task_slots ?? '-'}`,
+      e.last_seen_seconds_ago == null ? 'never'
+        : e.last_seen_seconds_ago.toFixed(1) + 's ago']);
+  }
+  const jb = document.querySelector('#jobs tbody'); jb.innerHTML = '';
+  for (const j of s.jobs) {
+    row(jb, [j.job_id, j.status, j.n_stages, j.error || '']);
+  }
+}
+refresh(); setInterval(refresh, 2000);
+</script>
+</body></html>
+"""
+
+
+def scheduler_state(server) -> dict:
+    """The /api/state payload (ref handlers.rs:34-57, extended with slot
+    and job detail the UI renders)."""
+    now = time.time()
+    executors = []
+    for em in server.executor_manager.all_executors():
+        data = server.executor_manager.get_executor_data(em.id)
+        seen = server.executor_manager.last_seen(em.id)
+        executors.append(
+            {
+                "id": em.id,
+                "host": em.host,
+                "port": em.port,
+                "grpc_port": em.grpc_port,
+                "total_task_slots": data.total_task_slots if data else None,
+                "available_task_slots": (
+                    data.available_task_slots if data else None
+                ),
+                "last_seen_seconds_ago": (
+                    round(now - seen, 3) if seen is not None else None
+                ),
+            }
+        )
+    with server._lock:
+        job_snapshot = list(server.jobs.values())
+    jobs = [
+        {
+            "job_id": j.job_id,
+            "status": j.status,
+            "n_stages": len(j.stages),
+            "error": j.error,
+        }
+        for j in job_snapshot
+    ]
+    return {
+        "executors": executors,
+        "jobs": jobs,
+        "started": int(server.start_time * 1000),
+        "uptime_seconds": now - server.start_time,
+        "policy": server.policy.value,
+        "version": BALLISTA_VERSION,
+    }
+
+
+def start_rest_server(server, host: str = "0.0.0.0", port: int = 0):
+    """Serve /api/state + the status page. Returns (httpd, bound_port)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            if path in ("/api/state", "/state"):
+                body = json.dumps(scheduler_state(server)).encode()
+                ctype = "application/json"
+            elif path == "/":
+                body = _UI_PAGE.encode()
+                ctype = "text/html; charset=utf-8"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            log.debug("rest: " + fmt, *args)
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True, name="rest")
+    t.start()
+    return httpd, httpd.server_address[1]

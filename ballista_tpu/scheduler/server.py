@@ -135,6 +135,8 @@ class SchedulerServer:
         state_backend=None,
         namespace: str = "default",
         policy: TaskSchedulingPolicy = TaskSchedulingPolicy.PULL_STAGED,
+        executor_timeout_s: float = 60.0,
+        expiry_check_interval_s: float = 15.0,
     ):
         """``state_backend``: a
         :class:`ballista_tpu.scheduler.state_backend.StateBackendClient`;
@@ -143,6 +145,12 @@ class SchedulerServer:
         persistent_state.rs:85-181 + the restart test :401-525)."""
         self.provider = provider
         self.config = config or BallistaConfig()
+        # the scheduler plans queries, so it must resolve UDF names too
+        # (plugin.py contract: client, scheduler, and executors all load
+        # the same plugin dir; $BALLISTA_PLUGIN_DIR is always consulted)
+        from ballista_tpu.plugin import load_plugins
+
+        load_plugins(self.config.plugin_dir() or None)
         self.codec = BallistaCodec(provider=provider)
         self.stage_manager = StageManager()
         self.executor_manager = ExecutorManager()
@@ -153,6 +161,11 @@ class SchedulerServer:
         # registration (ref grpc.rs:180-192) and launches tasks through it
         self.executor_clients: dict[str, object] = {}
         self._executor_channels: dict[str, object] = {}
+        # consecutive LaunchTask failures per executor; an executor that
+        # heartbeats but can't be dialed (NAT, bad --external-host) would
+        # otherwise soak offers forever
+        self._launch_failures: dict[str, int] = {}
+        self.max_launch_failures = 3
         self._lock = threading.RLock()
         self.state = None
         if state_backend is not None:
@@ -169,6 +182,49 @@ class SchedulerServer:
         import time as _time
 
         self.start_time = _time.time()
+        # executor-lost recovery: periodic expiry sweep (ref
+        # executor_manager.rs:55-77 expire_dead_executors + the
+        # RUNNING->PENDING reset transition stage_manager.rs:553-558)
+        self.executor_timeout_s = executor_timeout_s
+        self._expiry_stop = threading.Event()
+        self._expiry_thread = threading.Thread(
+            target=self._expiry_loop,
+            args=(expiry_check_interval_s,),
+            daemon=True,
+            name="executor-expiry",
+        )
+        self._expiry_thread.start()
+
+    def _expiry_loop(self, interval_s: float) -> None:
+        while not self._expiry_stop.wait(interval_s):
+            try:
+                self.check_expired_executors()
+            except Exception:  # noqa: BLE001
+                log.exception("executor expiry sweep failed")
+
+    def check_expired_executors(self) -> list[str]:
+        """Detect heartbeat-expired executors, reset their RUNNING tasks to
+        PENDING, drop them from slot accounting, and re-offer. Returns the
+        expired executor ids (exposed for tests and the REST /state view)."""
+        em = self.executor_manager
+        # read tracked BEFORE alive: an executor registering between the two
+        # snapshots is then in alive-but-not-tracked (harmless) instead of
+        # tracked-but-not-alive (would be expired at birth, resetting its
+        # just-launched tasks into duplicate execution)
+        tracked = em.tracked_executors()
+        alive = em.get_alive_executors(self.executor_timeout_s)
+        expired = tracked - alive
+        if not expired:
+            return []
+        for eid in expired:
+            self._drop_executor(eid)
+        reset = self.stage_manager.reset_tasks_of_executors(expired)
+        log.warning(
+            "executors %s expired; reset %d running tasks", expired, len(reset)
+        )
+        if reset and self.policy == TaskSchedulingPolicy.PUSH_STAGED:
+            self.event_loop.post(ReviveOffers())
+        return sorted(expired)
 
     def _recover_state(self) -> None:
         """Rebuild in-memory state from the backend on restart (ref
@@ -220,6 +276,11 @@ class SchedulerServer:
     def get_or_create_session(
         self, session_id: str, settings: dict[str, str]
     ) -> str:
+        plugin_dir = (settings or {}).get("ballista.plugin_dir")
+        if plugin_dir:
+            from ballista_tpu.plugin import load_plugins
+
+            load_plugins(plugin_dir)
         with self._lock:
             if session_id and session_id in self.sessions:
                 if settings:
@@ -405,6 +466,10 @@ class SchedulerServer:
         job.status = "completed"
         if self.state is not None:
             self.state.save_job(job)
+        # locations are snapshotted on the JobInfo; dropping the stage
+        # bookkeeping zeroes the inflight count (KEDA's scale signal) and
+        # stops fetch_schedulable_stage from ever seeing this job again
+        self.stage_manager.remove_job_stages(job_id)
         log.info("job %s completed (%d partitions)", job_id, len(flat))
 
     def _on_job_failed(self, job_id: str, error: str) -> None:
@@ -415,6 +480,10 @@ class SchedulerServer:
         job.error = error
         if self.state is not None:
             self.state.save_job(job)
+        # without this, the failed job's PENDING tasks stay schedulable
+        # forever: push mode would hot-loop JobFailed<->ReviveOffers on an
+        # unresolvable stage, and KEDA would never see the cluster go idle
+        self.stage_manager.remove_job_stages(job_id)
         log.error("job %s failed: %s", job_id, error)
 
     # -- task handout (pull mode; ref grpc.rs:121-147) -----------------------
@@ -436,8 +505,21 @@ class SchedulerServer:
         job = self.jobs[job_id]
         plan_bytes = job.resolved_plan_bytes.get(stage_id)
         if plan_bytes is None:
-            self._resolve_stage(job_id, stage_id)
-            plan_bytes = job.resolved_plan_bytes[stage_id]
+            try:
+                self._resolve_stage(job_id, stage_id)
+                plan_bytes = job.resolved_plan_bytes[stage_id]
+            except Exception as e:  # noqa: BLE001
+                # roll the RUNNING mark back so the task isn't leaked on an
+                # executor that never received it, and fail the job —
+                # resolution is deterministic, retrying can't help
+                self.stage_manager.update_task_status(
+                    task_id, TaskState.PENDING
+                )
+                self.event_loop.post(
+                    JobFailed(job_id, stage_id, f"stage resolution failed: {e}")
+                )
+                log.exception("stage %s/%s resolution failed", job_id, stage_id)
+                return None
         cfg = self.sessions.get(job.session_id, self.config)
         return pb.TaskDefinition(
             task_id=pb.PartitionId(
@@ -450,6 +532,124 @@ class SchedulerServer:
             ],
             session_id=job.session_id,
         )
+
+    # -- task handout (push mode; ref scheduler_server/event_loop.rs:35-169
+    # + state/task_scheduler.rs:53-211) --------------------------------------
+    def _drop_executor(self, executor_id: str) -> None:
+        """Remove one executor from scheduling: slot data, heartbeats,
+        dial-back client/channel, failure counter. Shared by the expiry
+        sweep, the launch-failure path, and shutdown."""
+        self.executor_manager.remove_executor(executor_id)
+        self._launch_failures.pop(executor_id, None)
+        with self._lock:
+            self.executor_clients.pop(executor_id, None)
+            ch = self._executor_channels.pop(executor_id, None)
+        if ch is not None:
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _get_executor_client(self, executor_id: str):
+        """Dial-back client to a push-mode executor's ExecutorGrpc service
+        (ref scheduler_grpc.rs:180-192 — the scheduler connects using the
+        grpc_port carried in RegisterExecutor metadata)."""
+        import grpc as _grpc
+
+        from ballista_tpu.scheduler.rpc import executor_stub
+
+        with self._lock:
+            stub = self.executor_clients.get(executor_id)
+            if stub is not None:
+                return stub
+            em = self.executor_manager.get_executor_metadata(executor_id)
+            if em is None or not em.grpc_port:
+                return None
+            ch = _grpc.insecure_channel(f"{em.host}:{em.grpc_port}")
+            stub = executor_stub(ch)
+            self._executor_channels[executor_id] = ch
+            self.executor_clients[executor_id] = stub
+            return stub
+
+    def _offer_resources(self) -> None:
+        """Round-robin pack pending tasks onto free executor slots and
+        LaunchTask each batch (ref task_scheduler.rs:53-211: walk executors
+        in most-free-first order assigning one task per visit until slots
+        or tasks run out; event_loop.rs:68-103 drives this on every
+        ReviveOffers)."""
+        if self.policy != TaskSchedulingPolicy.PUSH_STAGED:
+            return
+        assignments: dict[str, list[pb.TaskDefinition]] = {}
+        with self._lock:
+            execs = self.executor_manager.get_available_executors_data(
+                self.executor_timeout_s
+            )
+            free = sum(d.available_task_slots for d in execs)
+            i = 0
+            while free > 0:
+                d = execs[i % len(execs)]
+                i += 1
+                if d.available_task_slots <= 0:
+                    continue
+                try:
+                    td = self.next_task(d.executor_id)
+                except Exception:  # noqa: BLE001 — plan resolution failure
+                    log.exception("offer: next_task failed")
+                    break
+                if td is None:
+                    break
+                assignments.setdefault(d.executor_id, []).append(td)
+                d.available_task_slots -= 1
+                free -= 1
+                self.executor_manager.update_executor_data(d.executor_id, -1)
+        for eid, tasks in assignments.items():
+            stub = self._get_executor_client(eid)
+            ok = False
+            if stub is not None:
+                try:
+                    # deadline is load-bearing: this runs on the single
+                    # event-loop thread, and a blackholed executor without a
+                    # call deadline would wedge all scheduling
+                    stub.LaunchTask(
+                        pb.LaunchTaskParams(tasks=tasks), timeout=10.0
+                    )
+                    ok = True
+                    self._launch_failures.pop(eid, None)
+                except Exception as e:  # noqa: BLE001 — executor unreachable
+                    log.warning("LaunchTask to %s failed: %s", eid, e)
+            if not ok:
+                # roll back: tasks go RUNNING->PENDING (the legal executor-
+                # lost reset) and slots are returned
+                for td in tasks:
+                    self.stage_manager.update_task_status(
+                        PartitionId(
+                            td.task_id.job_id,
+                            td.task_id.stage_id,
+                            td.task_id.partition_id,
+                        ),
+                        TaskState.PENDING,
+                    )
+                self.executor_manager.update_executor_data(eid, len(tasks))
+                # a heartbeating-but-undialable executor would soak every
+                # re-offer forever; after N consecutive failures drop it
+                # from scheduling (its next heartbeat gets reregister=true,
+                # which retries the dial-back from scratch)
+                n_fail = self._launch_failures.get(eid, 0) + 1
+                self._launch_failures[eid] = n_fail
+                if n_fail >= self.max_launch_failures:
+                    log.error(
+                        "executor %s unreachable after %d LaunchTask "
+                        "attempts; dropping from scheduling", eid, n_fail,
+                    )
+                    self._drop_executor(eid)
+                # schedule a delayed re-offer (delayed, not immediate, so a
+                # persistently unreachable executor can't spin the event
+                # loop)
+                t = threading.Timer(
+                    1.0, self.event_loop.post, args=(ReviveOffers(),)
+                )
+                t.daemon = True
+                t.start()
 
     def apply_task_statuses(self, statuses: list[pb.TaskStatus]) -> None:
         """ref scheduler_server/mod.rs update_task_status :171-191."""
@@ -507,7 +707,16 @@ class SchedulerServer:
         )
 
     def shutdown(self) -> None:
+        self._expiry_stop.set()
         self.event_loop.stop()
+        with self._lock:
+            for ch in self._executor_channels.values():
+                try:
+                    ch.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._executor_channels.clear()
+            self.executor_clients.clear()
 
 
 class SchedulerGrpcServicer:
@@ -517,6 +726,17 @@ class SchedulerGrpcServicer:
         self.s = server
 
     def PollWork(self, request: pb.PollWorkParams, context):
+        # policy handshake: a pull-mode executor against a push-staged
+        # scheduler must fail loudly, not be silently half-served (the
+        # reference rejects PollWork under push-staged, grpc.rs:110-118)
+        if self.s.policy == TaskSchedulingPolicy.PUSH_STAGED:
+            import grpc as _grpc
+
+            context.abort(
+                _grpc.StatusCode.FAILED_PRECONDITION,
+                "scheduler is push-staged; start the executor with "
+                "--task-scheduling-policy push-staged",
+            )
         meta = request.metadata
         em = ExecutorMetadata(
             id=meta.id,
@@ -547,6 +767,16 @@ class SchedulerGrpcServicer:
         return result
 
     def RegisterExecutor(self, request, context):
+        # inverse policy handshake: a push-mode executor registering with a
+        # pull-staged scheduler would wait for LaunchTasks that never come
+        if self.s.policy != TaskSchedulingPolicy.PUSH_STAGED:
+            import grpc as _grpc
+
+            context.abort(
+                _grpc.StatusCode.FAILED_PRECONDITION,
+                "scheduler is pull-staged; start the executor with "
+                "--task-scheduling-policy pull-staged",
+            )
         meta = request.metadata
         em = ExecutorMetadata(
             id=meta.id,
@@ -560,16 +790,36 @@ class SchedulerGrpcServicer:
         self.s.executor_manager.save_executor_metadata(em)
         self.s.executor_manager.save_executor_heartbeat(meta.id)
         self.s.persist_executor(em)
-        self.s.executor_manager.save_executor_data(
-            ExecutorData(
-                meta.id, em.specification.task_slots, em.specification.task_slots
+        # keep existing slot accounting on re-registration (a recovered
+        # executor may still be draining pre-expiry tasks; resetting to
+        # full would oversubscribe it). After an expiry the data is gone and
+        # a fresh full grant is unavoidable — tasks still physically running
+        # from before the expiry can then transiently oversubscribe the
+        # executor by up to task_slots; they queue behind its runner pool,
+        # so the bound is 2x threads queued, not 2x executing
+        if self.s.executor_manager.get_executor_data(meta.id) is None:
+            self.s.executor_manager.save_executor_data(
+                ExecutorData(
+                    meta.id,
+                    em.specification.task_slots,
+                    em.specification.task_slots,
+                )
             )
-        )
+        # push mode: a new executor is new capacity — offer immediately
+        # (ref scheduler_grpc.rs:166-199)
+        if self.s.policy == TaskSchedulingPolicy.PUSH_STAGED:
+            self.s.event_loop.post(ReviveOffers())
         return pb.RegisterExecutorResult(success=True)
 
     def HeartBeatFromExecutor(self, request, context):
         self.s.executor_manager.save_executor_heartbeat(request.executor_id)
-        return pb.HeartBeatResult(reregister=False)
+        # an executor the expiry sweep dropped (or a scheduler that restarted
+        # without its registration) must re-register to get slots back
+        reregister = (
+            self.s.executor_manager.get_executor_data(request.executor_id)
+            is None
+        )
+        return pb.HeartBeatResult(reregister=reregister)
 
     def UpdateTaskStatus(self, request, context):
         self.s.apply_task_statuses(list(request.task_status))
@@ -582,6 +832,10 @@ class SchedulerGrpcServicer:
             self.s.executor_manager.update_executor_data(
                 request.executor_id, n_done
             )
+            # push mode: freed slots may unlock queued tasks even when no
+            # stage event fired (ref scheduler_grpc.rs:246-252)
+            if self.s.policy == TaskSchedulingPolicy.PUSH_STAGED:
+                self.s.event_loop.post(ReviveOffers())
         return pb.UpdateTaskStatusResult(success=True)
 
     def GetFileMetadata(self, request, context):
@@ -620,10 +874,11 @@ class SchedulerGrpcServicer:
         except Exception as e:  # noqa: BLE001
             log.exception("ExecuteQuery failed")
             job_id = generate_job_id()
-            self.s.jobs[job_id] = JobInfo(
-                job_id=job_id, session_id=session_id, status="failed",
-                error=str(e),
-            )
+            with self.s._lock:
+                self.s.jobs[job_id] = JobInfo(
+                    job_id=job_id, session_id=session_id, status="failed",
+                    error=str(e),
+                )
         return pb.ExecuteQueryResult(job_id=job_id, session_id=session_id)
 
     def GetJobStatus(self, request, context):
@@ -649,6 +904,11 @@ def start_scheduler_grpc(
         .ThreadPoolExecutor(max_workers=16)
     )
     add_service(gs, SCHEDULER_SERVICE, SCHEDULER_METHODS, SchedulerGrpcServicer(server))
+    # KEDA external scaler rides the same port (ref main.rs:136-166
+    # multiplexes gRPC services on the scheduler's bind address)
+    from ballista_tpu.scheduler.external_scaler import add_external_scaler
+
+    add_external_scaler(gs, server)
     bound = gs.add_insecure_port(f"{host}:{port}")
     gs.start()
     return gs, bound

@@ -209,7 +209,10 @@ class StageManager:
             key = (task_id.job_id, task_id.stage_id)
             stage = self._stages.get(key)
             if stage is None:
-                raise InternalError(f"unknown stage {key}")
+                # late status for a removed (failed/finished) job — drop it
+                # rather than corrupting counts (ref :536-586 is equally
+                # defensive about out-of-band updates)
+                return []
             if not (0 <= task_id.partition_id < stage.n_tasks):
                 raise InternalError(
                     f"task partition {task_id.partition_id} out of range "
@@ -262,6 +265,39 @@ class StageManager:
                 for i, t in enumerate(stage.tasks)
                 if t.state == TaskState.COMPLETED
             ]
+
+    def remove_job_stages(self, job_id: str) -> None:
+        """Drop every stage of a finished/failed job so dead tasks can't be
+        scheduled again and inflight counts (the KEDA signal) go to zero."""
+        with self._lock:
+            keys = [k for k in self._stages if k[0] == job_id]
+            for k in keys:
+                self._stages.pop(k, None)
+                self._running.discard(k)
+                self._pending.discard(k)
+                self._completed.discard(k)
+                self._dependencies.pop(k, None)
+            self._final_stage.pop(job_id, None)
+
+    def reset_tasks_of_executors(
+        self, executor_ids: set[str]
+    ) -> list[PartitionId]:
+        """Executor-lost recovery: every RUNNING task assigned to one of
+        ``executor_ids`` goes back to PENDING (the RUNNING->PENDING legal
+        transition, ref stage_manager.rs:553-558) so the next offer/poll can
+        hand it to a live executor. Returns the reset task ids."""
+        out: list[PartitionId] = []
+        with self._lock:
+            for (job_id, stage_id), stage in self._stages.items():
+                for i, t in enumerate(stage.tasks):
+                    if (
+                        t.state == TaskState.RUNNING
+                        and t.executor_id in executor_ids
+                    ):
+                        t.state = TaskState.PENDING
+                        t.executor_id = ""
+                        out.append(PartitionId(job_id, stage_id, i))
+        return out
 
     def has_running_tasks(self) -> bool:
         with self._lock:

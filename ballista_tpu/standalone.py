@@ -11,7 +11,7 @@ from __future__ import annotations
 import dataclasses
 import tempfile
 
-from ballista_tpu.config import BallistaConfig
+from ballista_tpu.config import BallistaConfig, TaskSchedulingPolicy
 from ballista_tpu.exec.planner import TableProvider
 from ballista_tpu.executor.executor import Executor, PollLoop, new_executor_id
 from ballista_tpu.executor.flight_service import start_flight_server
@@ -24,7 +24,8 @@ class StandaloneCluster:
     scheduler_grpc: object
     scheduler_port: int
     executor: Executor
-    poll_loop: PollLoop
+    # PollLoop (pull mode) or ExecutorServer (push mode); both expose .stop()
+    poll_loop: "PollLoop | object"
     flight_port: int
     work_dir: str
     _tmp: tempfile.TemporaryDirectory
@@ -36,12 +37,20 @@ class StandaloneCluster:
         concurrent_tasks: int = 4,
         provider: TableProvider | None = None,
         state_backend=None,
+        policy: TaskSchedulingPolicy = TaskSchedulingPolicy.PULL_STAGED,
+        executor_timeout_s: float = 60.0,
+        expiry_check_interval_s: float = 15.0,
     ) -> "StandaloneCluster":
         tmp = tempfile.TemporaryDirectory(prefix="ballista-standalone-")
         work_dir = tmp.name
 
         scheduler = SchedulerServer(
-            provider=provider, config=config, state_backend=state_backend
+            provider=provider,
+            config=config,
+            state_backend=state_backend,
+            policy=policy,
+            executor_timeout_s=executor_timeout_s,
+            expiry_check_interval_s=expiry_check_interval_s,
         )
         grpc_server, scheduler_port = start_scheduler_grpc(
             scheduler, "127.0.0.1", 0
@@ -53,14 +62,27 @@ class StandaloneCluster:
             provider=provider,
         )
         _svc, flight_port, _t = start_flight_server("127.0.0.1", 0, work_dir)
-        loop = PollLoop(
-            executor,
-            f"localhost:{scheduler_port}",
-            "localhost",
-            flight_port,
-            task_slots=concurrent_tasks,
-        )
-        loop.start()
+        if policy == TaskSchedulingPolicy.PUSH_STAGED:
+            from ballista_tpu.executor.executor_server import ExecutorServer
+
+            loop = ExecutorServer(
+                executor,
+                f"localhost:{scheduler_port}",
+                "localhost",
+                flight_port,
+                task_slots=concurrent_tasks,
+                heartbeat_interval_s=5.0,
+            )
+            loop.startup("127.0.0.1", 0)
+        else:
+            loop = PollLoop(
+                executor,
+                f"localhost:{scheduler_port}",
+                "localhost",
+                flight_port,
+                task_slots=concurrent_tasks,
+            )
+            loop.start()
         return cls(
             scheduler=scheduler,
             scheduler_grpc=grpc_server,

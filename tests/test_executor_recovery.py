@@ -1,0 +1,120 @@
+"""Executor-lost recovery: a task stuck RUNNING on a dead executor is reset
+to PENDING by the heartbeat-expiry sweep and re-run on a live executor, so
+the job still completes.
+
+Mirrors the reference's liveness filtering (executor_manager.rs:55-77) plus
+the RUNNING->PENDING reset transition (stage_manager.rs:553-558) that the
+reference declares legal; here the sweep actually invokes it.
+"""
+
+import subprocess
+import sys
+
+from tests.conftest import CPU_MESH_ENV
+
+SCRIPT = r"""
+import time
+
+import numpy as np
+import pyarrow as pa
+
+from ballista_tpu.client.context import BallistaContext
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.scheduler_types import ExecutorData, ExecutorMetadata
+from ballista_tpu.standalone import StandaloneCluster
+
+cfg = BallistaConfig().with_setting("ballista.shuffle.partitions", "3")
+ctx = BallistaContext.standalone(cfg)
+cluster = ctx._standalone_cluster
+sched = cluster.scheduler
+# tight liveness window so the test runs in seconds
+sched.executor_timeout_s = 1.5
+
+n = 8000
+r = np.random.default_rng(5)
+t = pa.table({
+    "k": pa.array(r.integers(0, 50, n)),
+    "v": pa.array(r.uniform(0, 100, n)),
+})
+ctx.register_table("t", t)
+
+# freeze the real executor so the zombie can grab a task deterministically
+cluster.poll_loop.stop()
+
+# a zombie executor registers, heartbeats once, takes a task, and dies
+sched.executor_manager.save_executor_metadata(
+    ExecutorMetadata(id="zombie", host="localhost", port=1)
+)
+sched.executor_manager.save_executor_heartbeat("zombie")
+sched.executor_manager.save_executor_data(ExecutorData("zombie", 4, 4))
+
+session_id = sched.get_or_create_session("", {})
+job_id = sched.submit_sql(
+    "select k, sum(v) as sv, count(*) as n from t group by k", session_id
+)
+sched.event_loop.drain()
+td = sched.next_task("zombie")
+assert td is not None, "zombie failed to grab a task"
+stuck = (td.task_id.job_id, td.task_id.stage_id, td.task_id.partition_id)
+
+# bring a live executor back online (fresh poll loop, same executor state)
+from ballista_tpu.executor.executor import PollLoop
+loop2 = PollLoop(
+    cluster.executor,
+    f"localhost:{cluster.scheduler_port}",
+    "localhost",
+    cluster.flight_port,
+    task_slots=4,
+)
+loop2.start()
+
+# without recovery the job hangs forever on the zombie's RUNNING task;
+# the expiry sweep must reset it and let the live executor finish
+deadline = time.time() + 120
+while time.time() < deadline:
+    sched.check_expired_executors()
+    if "zombie" not in sched.executor_manager.tracked_executors():
+        break
+    time.sleep(0.2)
+while time.time() < deadline and sched.jobs[job_id].status not in (
+    "completed", "failed"
+):
+    time.sleep(0.2)
+
+assert "zombie" not in sched.executor_manager.tracked_executors()
+assert sched.jobs[job_id].status == "completed", (
+    sched.jobs[job_id].status, sched.jobs[job_id].error
+)
+
+# the job's results are intact: fetch the completed partitions directly
+from ballista_tpu.executor.reader import fetch_partition_table
+tables = [fetch_partition_table(loc)
+          for loc in sched.jobs[job_id].completed_locations]
+res = pa.concat_tables([t for t in tables if t.num_rows]).to_pandas()
+df = t.to_pandas()
+want = (df.groupby("k").agg(sv=("v", "sum"), n=("v", "count"))
+        .reset_index())
+res = res.sort_values("k").reset_index(drop=True)
+want = want.sort_values("k").reset_index(drop=True)
+np.testing.assert_array_equal(res.k, want.k)
+np.testing.assert_array_equal(res.n, want.n)
+np.testing.assert_allclose(res.sv, want.sv, rtol=1e-9)
+
+loop2.stop()
+ctx.close()
+print("RECOVERY-OK", stuck)
+"""
+
+
+def test_dead_executor_task_reset():
+    proc = subprocess.run(
+        [sys.executable, "-c", SCRIPT],
+        env=CPU_MESH_ENV,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "RECOVERY-OK" in proc.stdout

@@ -1,0 +1,203 @@
+"""Process lifecycle: real scheduler + executor processes via the
+``python -m`` entrypoints, REST /state, KEDA scaler, shuffle TTL cleanup.
+
+ref scheduler/src/main.rs:65-198, executor/src/main.rs:64-296,
+api/handlers.rs:34-57, scheduler_server/external_scaler.rs:31-66.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tests.conftest import CPU_MESH_ENV
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_cleanup_ttl(tmp_path):
+    """Expired job dirs are deleted; fresh ones survive (ref main.rs:205-257)."""
+    from ballista_tpu.executor.cleanup import clean_shuffle_data
+
+    old_job = tmp_path / "job-old" / "1" / "0"
+    old_job.mkdir(parents=True)
+    (old_job / "data-0.arrow").write_bytes(b"x")
+    new_job = tmp_path / "job-new" / "1" / "0"
+    new_job.mkdir(parents=True)
+    (new_job / "data-0.arrow").write_bytes(b"y")
+
+    stale = time.time() - 3600
+    for root, dirs, files in os.walk(tmp_path / "job-old", topdown=False):
+        for name in files + dirs:
+            os.utime(os.path.join(root, name), (stale, stale))
+    os.utime(tmp_path / "job-old", (stale, stale))
+
+    deleted = clean_shuffle_data(str(tmp_path), ttl_seconds=600)
+    assert deleted == ["job-old"]
+    assert not (tmp_path / "job-old").exists()
+    assert (new_job / "data-0.arrow").exists()
+
+    # loose files in work_dir are never touched
+    assert clean_shuffle_data(str(tmp_path), ttl_seconds=0) == ["job-new"]
+
+
+@pytest.fixture
+def cluster_procs(tmp_path):
+    """Real `python -m` scheduler + executor child processes."""
+    sched_port, rest_port = _free_port(), _free_port()
+    flight_port, grpc_port = _free_port(), _free_port()
+    env = dict(CPU_MESH_ENV)
+    procs = []
+    try:
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "ballista_tpu.scheduler",
+                    "--bind-host", "127.0.0.1",
+                    "--bind-port", str(sched_port),
+                    "--rest-port", str(rest_port),
+                    "--state-backend", "sqlite",
+                    "--state-path", str(tmp_path / "state.db"),
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+        time.sleep(2.0)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "ballista_tpu.executor",
+                    "--bind-host", "127.0.0.1",
+                    "--external-host", "127.0.0.1",
+                    "--bind-port", str(flight_port),
+                    "--bind-grpc-port", str(grpc_port),
+                    "--scheduler-host", "127.0.0.1",
+                    "--scheduler-port", str(sched_port),
+                    "--work-dir", str(tmp_path / "work"),
+                    "--job-data-ttl-seconds", "3600",
+                    "--job-data-clean-up-interval-seconds", "1",
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+        yield sched_port, rest_port, procs
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_process_entrypoints_end_to_end(tmp_path, cluster_procs):
+    """A client runs SQL against scheduler+executor child processes over an
+    external CSV table (self-contained plan serde — no shared memory)."""
+    sched_port, rest_port, procs = cluster_procs
+
+    csv = tmp_path / "points.csv"
+    csv.write_text(
+        "k,v\n" + "\n".join(f"{i % 5},{i * 1.5}" for i in range(1000)) + "\n"
+    )
+
+    script = f"""
+import time
+from ballista_tpu.client.context import BallistaContext
+
+deadline = time.time() + 60
+last = None
+while True:
+    try:
+        ctx = BallistaContext.remote("127.0.0.1", {sched_port})
+        break
+    except Exception as e:
+        last = e
+        if time.time() > deadline:
+            raise
+        time.sleep(0.5)
+
+ctx.sql(
+    "create external table pts (k bigint, v double) "
+    "stored as csv with header row location '{csv}'"
+)
+res = ctx.sql(
+    "select k, sum(v) as sv, count(*) as n from pts group by k order by k"
+).collect().to_pandas()
+assert len(res) == 5, res
+assert int(res.n.sum()) == 1000, res
+import numpy as np
+want = sum(i * 1.5 for i in range(1000))
+np.testing.assert_allclose(res.sv.sum(), want, rtol=1e-9)
+print("ENTRYPOINT-OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        env=CPU_MESH_ENV,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    if proc.returncode != 0:
+        for p in procs:
+            p.terminate()
+        logs = "\n---\n".join(
+            p.communicate()[0] or "" for p in procs
+        )
+        raise AssertionError(
+            f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}\nprocs:\n{logs}"
+        )
+    assert "ENTRYPOINT-OK" in proc.stdout
+
+    # REST /api/state sees the executor and the completed job
+    state = json.loads(
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{rest_port}/api/state", timeout=10
+        ).read()
+    )
+    assert state["version"]
+    assert len(state["executors"]) == 1
+    assert state["executors"][0]["total_task_slots"] == 4
+    assert any(j["status"] == "completed" for j in state["jobs"]), state
+
+    # the UI page serves
+    page = urllib.request.urlopen(
+        f"http://127.0.0.1:{rest_port}/", timeout=10
+    ).read()
+    assert b"ballista-tpu scheduler" in page
+
+    # KEDA external scaler answers on the scheduler's gRPC port
+    import grpc
+
+    from ballista_tpu.proto import pb
+    from ballista_tpu.scheduler.external_scaler import (
+        EXTERNAL_SCALER_METHODS,
+        EXTERNAL_SCALER_SERVICE,
+    )
+    from ballista_tpu.scheduler.rpc import _Stub
+
+    ch = grpc.insecure_channel(f"127.0.0.1:{sched_port}")
+    stub = _Stub(ch, EXTERNAL_SCALER_SERVICE, EXTERNAL_SCALER_METHODS)
+    spec = stub.GetMetricSpec(pb.ScaledObjectRef(name="x", namespace="d"))
+    assert spec.metricSpecs[0].metricName == "inflight_tasks"
+    assert spec.metricSpecs[0].targetSize == 1
+    active = stub.IsActive(pb.ScaledObjectRef(name="x", namespace="d"))
+    assert active.result is False  # job finished, nothing running
+    metrics = stub.GetMetrics(pb.GetMetricsRequest(metricName="inflight_tasks"))
+    assert metrics.metricValues[0].metricValue == 0
+    ch.close()

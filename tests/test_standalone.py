@@ -15,14 +15,20 @@ from tests.conftest import CPU_MESH_ENV
 
 SCRIPT = r"""
 import datetime
+import os
 
 import numpy as np
 import pyarrow as pa
 
 from ballista_tpu.client.context import BallistaContext
-from ballista_tpu.config import BallistaConfig
+from ballista_tpu.config import BallistaConfig, TaskSchedulingPolicy
 
-ctx = BallistaContext.standalone()
+# policy parity: the same workload must pass under pull- and push-staged
+# scheduling (ref scheduler_server/mod.rs:280-615 runs its suite under both)
+policy = TaskSchedulingPolicy.parse(
+    os.environ.get("BALLISTA_TEST_POLICY", "pull-staged")
+)
+ctx = BallistaContext.standalone(policy=policy)
 
 # SELECT 1 smoke (ref context.rs:444-453)
 out = ctx.sql("select 1").collect()
@@ -84,10 +90,14 @@ print("STANDALONE-OK")
 """
 
 
-def test_standalone_cluster():
+import pytest
+
+
+@pytest.mark.parametrize("policy", ["pull-staged", "push-staged"])
+def test_standalone_cluster(policy):
     proc = subprocess.run(
         [sys.executable, "-c", SCRIPT],
-        env=CPU_MESH_ENV,
+        env={**CPU_MESH_ENV, "BALLISTA_TEST_POLICY": policy},
         capture_output=True,
         text=True,
         timeout=420,
