@@ -1,0 +1,115 @@
+"""CLI shell tests: scripted statements, backslash commands, print formats,
+remote mode against a standalone cluster.
+
+ref ballista-cli/src/{main,exec,command}.rs + print_format.rs.
+"""
+
+import io
+import json
+import subprocess
+import sys
+
+from tests.conftest import CPU_MESH_ENV
+
+
+def _run_local(stdin: str, *extra_args: str) -> str:
+    proc = subprocess.run(
+        [sys.executable, "-m", "ballista_tpu.cli", *extra_args],
+        input=stdin,
+        env=CPU_MESH_ENV,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    return proc.stdout
+
+
+def test_cli_script_local(tmp_path):
+    csv = tmp_path / "t.csv"
+    csv.write_text("a,b\n1,x\n2,y\n3,x\n")
+    out = _run_local(
+        f"create external table t (a bigint, b varchar) "
+        f"stored as csv with header row location '{csv}';\n"
+        "select b, count(*) as n from t group by b order by b;\n"
+        "\\d\n"
+        "\\d t\n"
+        "\\h\n"
+        "\\h substr\n"
+        "\\q\n"
+    )
+    assert "x" in out and "y" in out
+    assert "table_name" in out  # \d -> show tables
+    assert "column_name" in out  # \d t -> show columns
+    assert "substr" in out  # \h
+    assert "row(s) in set" in out
+
+
+def test_cli_print_formats(tmp_path):
+    csv = tmp_path / "t.csv"
+    csv.write_text("a\n1\n2\n")
+    base = (
+        f"create external table t (a bigint) "
+        f"stored as csv with header row location '{csv}';\n"
+    )
+    out = _run_local(
+        base + "\\pset format csv\nselect * from t order by a;\n", "-q"
+    )
+    assert "a\n1\n2" in out.replace('"', "")
+    out = _run_local(
+        base + "\\pset format json\nselect * from t order by a;\n", "-q"
+    )
+    rows = json.loads(
+        [l for l in out.splitlines() if l.startswith("[")][0]
+    )
+    assert rows == [{"a": 1}, {"a": 2}]
+    out = _run_local(
+        base + "\\pset format ndjson\nselect * from t order by a;\n", "-q"
+    )
+    nd = [json.loads(l) for l in out.splitlines() if l.startswith("{")]
+    assert nd == [{"a": 1}, {"a": 2}]
+
+
+def test_cli_file_mode(tmp_path):
+    csv = tmp_path / "t.csv"
+    csv.write_text("a\n5\n7\n")
+    script = tmp_path / "q.sql"
+    script.write_text(
+        f"create external table t (a bigint) "
+        f"stored as csv with header row location '{csv}';\n"
+        "select sum(a) as s from t;\n"
+    )
+    out = _run_local("", "-f", str(script))
+    assert "12" in out
+
+
+def test_cli_quiet_and_errors(tmp_path):
+    out = _run_local("select * from nosuch;\n\\q\n", "-q")
+    assert "error:" in out
+    assert "row(s) in set" not in out
+
+
+def test_cli_multiline_statement(tmp_path):
+    csv = tmp_path / "t.csv"
+    csv.write_text("a\n1\n2\n3\n")
+    out = _run_local(
+        f"create external table t (a bigint) "
+        f"stored as csv with header row location '{csv}';\n"
+        "select\n  sum(a) as s\nfrom t\nwhere a > 1;\n",
+        "-q",
+    )
+    assert "5" in out
+
+
+def test_format_batch_unit():
+    import pyarrow as pa
+
+    from ballista_tpu.cli import format_batch
+
+    t = pa.table({"x": pa.array([1, 2]), "s": pa.array(["a", "b"])})
+    assert "x" in format_batch(t, "table")
+    assert format_batch(t, "csv").splitlines()[0].replace('"', "") == "x,s"
+    assert format_batch(t, "tsv").splitlines()[1].split("\t")[0] == "1"
+    assert json.loads(format_batch(t, "json"))[1]["s"] == "b"
+    empty = pa.table({"x": pa.array([], type=pa.int64())})
+    assert format_batch(empty, "table") == "(empty)"
