@@ -48,6 +48,11 @@ class TpuContext(Catalog, TableProvider):
 
     def __init__(self, config: BallistaConfig | None = None):
         self.config = config or BallistaConfig()
+        # UDF plugins (ref plugin/mod.rs: loaded once at context creation;
+        # both the ballista.plugin_dir key and $BALLISTA_PLUGIN_DIR count)
+        from ballista_tpu.plugin import load_plugins
+
+        load_plugins(self.config.plugin_dir() or None)
         self.tables: dict[str, _Registered] = {}
         self._mesh_runtime = None
         self._mesh_checked = False

@@ -116,6 +116,9 @@ class HashJoinExec(ExecutionPlan):
         self.filter = filter
         self.partition_mode = partition_mode
         self._filtered_probe_cache: dict = {}
+        # build-strategy flags (dups/overflow of the collected right side)
+        # are partition-invariant: compute once, reuse across partitions
+        self._decide_flags: tuple[bool, bool] | None = None
         ls, rs = left.schema(), right.schema()
         for a, b in self.on:
             if not (isinstance(a, L.Column) and isinstance(b, L.Column)):
@@ -267,10 +270,18 @@ class HashJoinExec(ExecutionPlan):
         if first is None:
             return
 
-        bb, pb = self._unify_key_dicts(right_batch, first, right_keys, left_keys)
-        with self.metrics.time("build_time"):
-            bt = build_side(bb, right_keys)
-        bt_dups, bt_ovf = bt.flags()
+        # Decide the build strategy from the UN-unified right batch: dup and
+        # collision-overflow flags on the original codes are identical on
+        # every partition, so all partitions take the same branch. (Deciding
+        # after dictionary unification with this partition's first probe
+        # batch could disagree with partition 0 — and a disagreeing
+        # partition would silently emit nothing.)
+        decide = None
+        if self._decide_flags is None:
+            with self.metrics.time("build_time"):
+                decide = build_side(right_batch, right_keys)
+            self._decide_flags = decide.flags()
+        bt_dups, bt_ovf = self._decide_flags
         if bt_dups or bt_ovf:
             # Right side can't serve as a unique build (dups, or a hash-mode
             # collision run past the probe window). Deterministic across
@@ -317,6 +328,21 @@ class HashJoinExec(ExecutionPlan):
             yield out
             return
 
+        bb, pb = self._unify_key_dicts(right_batch, first, right_keys, left_keys)
+        if bb is right_batch and decide is not None:
+            bt = decide  # common case: unification was a no-op, reuse
+        else:
+            with self.metrics.time("build_time"):
+                bt = build_side(bb, right_keys)
+            # Post-unification remapped codes could in principle introduce a
+            # packed-hash collision run the original codes didn't have. The
+            # contradiction is partition-local (it depends on this
+            # partition's probe dictionary), so no silent fallback is sound
+            # — expansion can't count overflowed runs, and a per-partition
+            # branch change is exactly the silent row-drop this decision
+            # restructure removed. Raise loudly; integer keys avoid packing.
+            bt.check_unique()
+            bt.check_overflow()
         base = bb
 
         def _rest():
@@ -329,6 +355,7 @@ class HashJoinExec(ExecutionPlan):
                 with self.metrics.time("build_time"):
                     bt = build_side(bb2, right_keys)
                 bt.check_unique()
+                bt.check_overflow()
                 base = bb2
             joined = self._probe_with_filter(bt, pb, left_keys, JoinSide.INNER)
             out = self._restore_column_order(joined, pb, bt.batch, True)

@@ -56,6 +56,14 @@ class Executor:
     ) -> list:
         """Decode + rebind work_dir + run one input partition
         (ref executor.rs:81-114)."""
+        props_early = {kv.key: kv.value for kv in task.props}
+        plugin_dir = props_early.get("ballista.plugin_dir", "")
+        if plugin_dir:
+            # UDF plugins must be resolvable before plan decode builds
+            # ScalarFunction nodes (ref plugin serde: names-only wire format)
+            from ballista_tpu.plugin import load_plugins
+
+            load_plugins(plugin_dir)
         node = pb.PhysicalPlanNode()
         node.ParseFromString(task.plan)
         plan = self.codec.physical_from_proto(node)
@@ -64,7 +72,7 @@ class Executor:
                 "task plan root must be ShuffleWriterExec "
                 f"(got {type(plan).__name__})"
             )
-        props = {kv.key: kv.value for kv in task.props}
+        props = props_early
         out = run_with_capacity_retry(
             BallistaConfig(props) if props else BallistaConfig(),
             lambda ctx: plan.execute_shuffle_write(

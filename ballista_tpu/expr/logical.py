@@ -687,15 +687,26 @@ class ScalarFunction(Expr):
     def __post_init__(self):
         spec = _SCALAR_FUNCS.get(self.fname)
         if spec is None:
-            raise PlanError(f"unknown scalar function {self.fname!r}")
-        _, lo, hi = spec
+            # UDF plugins (ballista_tpu/plugin.py, ref core/src/plugin/)
+            from ballista_tpu.plugin import lookup_udf
+
+            udf = lookup_udf(self.fname)  # raises PlanError when unknown
+            lo, hi = udf.min_args, udf.max_args
+        else:
+            _, lo, hi = spec
         if not (lo <= len(self.args) <= hi):
             raise PlanError(
                 f"{self.fname} takes {lo}..{hi} args, got {len(self.args)}"
             )
 
     def data_type(self, schema: Schema) -> DataType:
-        rule = _SCALAR_FUNCS[self.fname][0]
+        spec = _SCALAR_FUNCS.get(self.fname)
+        if spec is None:
+            from ballista_tpu.plugin import lookup_udf
+
+            rule = lookup_udf(self.fname).return_type
+        else:
+            rule = spec[0]
         if rule == "same":
             return self.args[0].data_type(schema)
         if rule == "common":

@@ -683,6 +683,28 @@ def _compile_scalar_fn(expr: L.ScalarFunction, schema: Schema):
 
         return fn
 
+    # UDF plugins: the body is jax-traceable, so it fuses into the stage
+    # program like a built-in (ballista_tpu/plugin.py, ref core/src/plugin/)
+    from ballista_tpu.plugin import global_registry
+
+    udf = global_registry.get(name)
+    if udf is not None:
+        g = udf.fn
+
+        def fn(batch: DeviceBatch) -> ColumnValue:
+            vs = [a(batch) for a in args]
+            out = g(*[v.values for v in vs])
+            # null-strict: result is NULL where any argument is NULL
+            nulls = None
+            for v in vs:
+                if v.nulls is not None:
+                    nulls = v.nulls if nulls is None else (nulls | v.nulls)
+            return ColumnValue(
+                jnp.asarray(out).astype(out_dtype.to_np()), nulls, out_dtype
+            )
+
+        return fn
+
     raise PlanError(f"unknown scalar function {name!r}")
 
 

@@ -1,0 +1,180 @@
+"""UDF plugin system.
+
+ref ballista/rust/core/src/plugin/{mod.rs:36-127, plugin_manager.rs, udf.rs}:
+a global PluginManager scans a plugin directory (env
+``BALLISTA_PLUGIN_DIR`` or the ``ballista.plugin_dir`` config key) and loads
+every plugin it finds; the one plugin kind is scalar UDFs. The reference
+loads ``.so`` cdylibs exposing a registrar symbol; the tpu-native
+equivalent loads ``.py`` modules exposing ``register(register_udf)``, and a
+UDF body is a jax-traceable callable over ``jnp`` arrays — it fuses into
+the surrounding XLA program like any built-in.
+
+A plugin file looks like::
+
+    # my_udfs.py, dropped into the plugin dir
+    import jax.numpy as jnp
+    from ballista_tpu.datatypes import DataType
+
+    def register(register_udf):
+        register_udf("clamp01", lambda x: jnp.clip(x, 0.0, 1.0),
+                     DataType.FLOAT64)
+
+Both the client/scheduler process (planning: name resolution + return
+types) and each executor process (execution) load the same plugin dir; the
+wire format carries only the function name (serde.py ScalarFunctionNode),
+exactly like the reference's UDF serde.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib.util
+import logging
+import os
+import sys
+import threading
+
+from ballista_tpu.datatypes import DataType
+from ballista_tpu.errors import PlanError
+
+log = logging.getLogger(__name__)
+
+PLUGIN_DIR_ENV = "BALLISTA_PLUGIN_DIR"  # ref plugin/mod.rs:36-44
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarUdf:
+    """One registered scalar UDF.
+
+    ``fn`` maps jnp value arrays -> a jnp value array (nulls are propagated
+    outside the fn as the union of argument nulls, SQL semantics for a
+    null-strict function). ``return_type`` is a DataType, or "same" to
+    inherit argument 0's type."""
+
+    name: str
+    fn: object
+    return_type: object = "same"
+    min_args: int = 1
+    max_args: int = 1
+
+
+class UdfRegistry:
+    """Process-global UDF table (ref plugin_manager.rs global manager)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._udfs: dict[str, ScalarUdf] = {}
+        # dir -> Event set once its plugins are fully registered; a second
+        # loader of the same dir blocks until then (concurrent push-mode
+        # task threads must not see a half-loaded registry)
+        self._dir_loads: dict[str, threading.Event] = {}
+
+    def register(
+        self,
+        name: str,
+        fn,
+        return_type=DataType.FLOAT64,
+        min_args: int = 1,
+        max_args: int | None = None,
+    ) -> None:
+        name = name.lower()
+        with self._lock:
+            self._udfs[name] = ScalarUdf(
+                name, fn, return_type, min_args, max_args or min_args
+            )
+
+    def get(self, name: str) -> ScalarUdf | None:
+        with self._lock:
+            return self._udfs.get(name.lower())
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._udfs)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._udfs.clear()
+            self._dir_loads.clear()
+
+    def load_dir(self, plugin_dir: str) -> list[str]:
+        """Import every ``*.py`` in ``plugin_dir`` and call its
+        ``register`` hook (ref mod.rs load loop :87-127). Idempotent per
+        directory; concurrent callers block until the first load completes.
+        Returns the module names loaded."""
+        plugin_dir = os.path.abspath(plugin_dir)
+        with self._lock:
+            done = self._dir_loads.get(plugin_dir)
+            if done is not None:
+                first = False
+            else:
+                done = threading.Event()
+                self._dir_loads[plugin_dir] = done
+                first = True
+        if not first:
+            done.wait()
+            return []
+        retry = False
+        try:
+            loaded = []
+            if not os.path.isdir(plugin_dir):
+                # do NOT cache the miss: the dir may appear later (e.g. a
+                # volume mount racing pod start), and per-task load_plugins
+                # exists precisely to re-resolve then
+                log.warning("plugin dir %s does not exist", plugin_dir)
+                retry = True
+                return loaded
+            for fname in sorted(os.listdir(plugin_dir)):
+                if not fname.endswith(".py") or fname.startswith("_"):
+                    continue
+                mod_name = f"ballista_plugin_{fname[:-3]}"
+                path = os.path.join(plugin_dir, fname)
+                try:
+                    spec = importlib.util.spec_from_file_location(
+                        mod_name, path
+                    )
+                    module = importlib.util.module_from_spec(spec)
+                    sys.modules[mod_name] = module
+                    spec.loader.exec_module(module)
+                    hook = getattr(module, "register", None)
+                    if hook is None:
+                        log.warning("plugin %s has no register() hook", path)
+                        continue
+                    hook(self.register)
+                    loaded.append(mod_name)
+                except Exception:  # noqa: BLE001 — one bad plugin can't
+                    # kill boot, but its failure must not be cached as
+                    # success: the next load_dir retries the whole dir
+                    # (register() overwrite semantics make re-import safe)
+                    log.exception("failed to load plugin %s", path)
+                    retry = True
+            if loaded:
+                log.info(
+                    "loaded %d UDF plugins from %s", len(loaded), plugin_dir
+                )
+            return loaded
+        finally:
+            if retry:
+                with self._lock:
+                    self._dir_loads.pop(plugin_dir, None)
+            done.set()
+
+
+# The process-global registry. Planning (expr/logical.py) and execution
+# (expr/physical.py) resolve unknown function names against it.
+global_registry = UdfRegistry()
+
+
+def load_plugins(plugin_dir: str | None = None) -> list[str]:
+    """Load plugins from an explicit dir and/or $BALLISTA_PLUGIN_DIR."""
+    loaded: list[str] = []
+    for d in (plugin_dir, os.environ.get(PLUGIN_DIR_ENV)):
+        if d:
+            loaded += global_registry.load_dir(d)
+    return loaded
+
+
+def lookup_udf(name: str) -> ScalarUdf:
+    udf = global_registry.get(name)
+    if udf is None:
+        raise PlanError(f"unknown scalar function {name!r}")
+    return udf
