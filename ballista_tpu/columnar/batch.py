@@ -182,27 +182,62 @@ class DeviceBatch:
         )
 
     # -- host materialization ------------------------------------------------
+    # Above this many bytes, fetching the full padded capacity costs more
+    # than an extra round trip + a device-side compaction (tunnelled-TPU
+    # D2H runs ~10MB/s, one sync ~0.1s, so the break-even is ~1-2MB).
+    _SLICED_FETCH_BYTES = 4 << 20
+
     def to_host(self) -> tuple[Schema, list[np.ndarray], list[np.ndarray | None]]:
         """Gather live rows back to host (compacts: drops invalid rows).
 
         Returns (schema, columns, null_masks) with exact row count.
-        """
-        # One batched device_get: per-array fetches cost a full host round
-        # trip each (~100ms on a tunnelled TPU); fetching the whole batch at
-        # once pipelines the transfers.
-        import jax
 
-        host = jax.device_get(
-            (self.valid, self.columns,
-             [m for m in self.nulls if m is not None])
+        Two fetch strategies, chosen by padded size: small batches fetch
+        the whole capacity in ONE batched device_get (a single host round
+        trip); large sparse batches (e.g. a 262k-capacity aggregate state
+        holding 6 groups) first sync the live count (tiny), compact on
+        device, and fetch only a tight power-of-two slice — bytes moved
+        scale with live rows, not capacity.
+        """
+        # Per-array fetches cost a full host round trip each; fetch_arrays
+        # packs everything into one device buffer and moves it in a single
+        # round trip. The sliced strategy adds one tiny count sync first.
+        from ballista_tpu.ops.fetch import fetch_arrays
+
+        n_null = sum(1 for m in self.nulls if m is not None)
+        padded_bytes = sum(c.dtype.itemsize for c in self.columns)
+        padded_bytes = (padded_bytes + 1 + n_null) * self.capacity
+        b = self
+        if padded_bytes > self._SLICED_FETCH_BYTES:
+            n = int(fetch_arrays([self.count_valid()])[0])
+            if n * 4 <= self.capacity:
+                from ballista_tpu.ops.compact import compact
+
+                b = compact(self)
+                m = 8
+                while m < n:
+                    m <<= 1
+                b = DeviceBatch(
+                    schema=b.schema,
+                    columns=tuple(c[:m] for c in b.columns),
+                    valid=b.valid[:m],
+                    nulls=tuple(
+                        None if mm is None else mm[:m] for mm in b.nulls
+                    ),
+                    dictionaries=dict(b.dictionaries),
+                )
+        fetched = fetch_arrays(
+            [b.valid, *b.columns, *[m for m in b.nulls if m is not None]]
         )
-        valid, cols_h, null_arrs = host
+        valid = fetched[0]
+        cols_h = fetched[1 : 1 + len(b.columns)]
+        null_arrs = fetched[1 + len(b.columns) :]
         idx = np.nonzero(valid)[0]
         cols = [np.asarray(c)[idx] for c in cols_h]
         it = iter(null_arrs)
         nulls = [
             None if m is None else np.asarray(next(it))[idx]
-            for m in self.nulls
+            for m in b.nulls
         ]
         return self.schema, cols, nulls
 

@@ -58,3 +58,15 @@ class CapacityError(ExecutionError):
     def __init__(self, message: str, required: int = 0):
         super().__init__(message)
         self.required = int(required)
+
+
+class SpeculationMiss(ExecutionError):
+    """A cached plan-shape speculation (join build strategy, expansion
+    output capacity) was contradicted by this run's data. The run's output
+    must be discarded; the driver drops ``invalid_keys`` from the plan
+    cache and re-runs on the non-speculative path. TPU-only concern: the
+    speculation exists to avoid blocking host round-trips."""
+
+    def __init__(self, message: str, invalid_keys: list | None = None):
+        super().__init__(message)
+        self.invalid_keys = list(invalid_keys or [])

@@ -50,6 +50,14 @@ class TaskContext:
     # checks enqueue here and the task boundary fetches them all in ONE
     # device_get (raise_deferred) instead of one sync per operator.
     deferred_checks: list = dataclasses.field(default_factory=list)
+    # Cross-run plan-shape cache (join build-strategy flags, expansion
+    # output capacities), owned by the context/executor and shared across
+    # queries. Entries are SPECULATIVE: every use must queue a validation
+    # flag via defer_speculation; a fired flag discards the run and the
+    # driver retries without the stale entry.
+    plan_cache: dict | None = None
+    # validation flags for plan_cache entries: (flag, message, cache_keys)
+    speculative_checks: list = dataclasses.field(default_factory=list)
 
     def defer_check(self, flag, message: str, required=None) -> None:
         """Queue a device bool ``flag``; if it fires at the task boundary the
@@ -58,23 +66,53 @@ class TaskContext:
         CapacityError so the driver can retry adaptively."""
         self.deferred_checks.append((flag, message, required))
 
+    def defer_speculation(self, flag, message: str, cache_keys: list) -> None:
+        """Queue a device bool validating a plan_cache speculation; if it
+        fires, the task raises SpeculationMiss carrying ``cache_keys`` so
+        the driver can invalidate and re-run. Rides the same single batched
+        fetch as defer_check — zero extra round trips."""
+        self.speculative_checks.append((flag, message, list(cache_keys)))
+
     def raise_deferred(self) -> None:
-        if not self.deferred_checks:
+        if not self.deferred_checks and not self.speculative_checks:
             return
-        import jax
+        from ballista_tpu.errors import (
+            CapacityError,
+            ExecutionError,
+            SpeculationMiss,
+        )
+        from ballista_tpu.ops.fetch import fetch_arrays
 
-        from ballista_tpu.errors import CapacityError, ExecutionError
+        import jax.numpy as jnp
 
-        fetch = [
-            [f for f, _, _ in self.deferred_checks],
-            [
-                r if r is not None else 0
+        n = len(self.deferred_checks)
+        fetched = fetch_arrays(
+            [jnp.asarray(f) for f, _, _ in self.deferred_checks]
+            + [
+                jnp.asarray(r if r is not None else 0)
                 for _, _, r in self.deferred_checks
-            ],
-        ]
-        flags, reqs = jax.device_get(fetch)
+            ]
+            + [jnp.asarray(f) for f, _, _ in self.speculative_checks]
+        )
+        flags, reqs = fetched[:n], fetched[n : 2 * n]
+        spec_flags = fetched[2 * n :]
         checks = self.deferred_checks
+        spec_checks = self.speculative_checks
         self.deferred_checks = []
+        self.speculative_checks = []
+        # speculation misses first: the run's output is invalid regardless
+        # of what the hard checks say (a stale strategy can mask them)
+        spec_fired = [
+            (m, keys)
+            for (f_, m, keys), f in zip(spec_checks, spec_flags)
+            if bool(f)
+        ]
+        if spec_fired:
+            invalid = [k for _, keys in spec_fired for k in keys]
+            raise SpeculationMiss(
+                "; ".join(dict.fromkeys(m for m, _ in spec_fired)),
+                invalid_keys=invalid,
+            )
         fired = [
             (m, int(r))
             for (f_, m, req), f, r in zip(checks, flags, reqs)
@@ -96,7 +134,11 @@ AGG_CAPACITY_HARD_MAX = 1 << 23
 
 
 def run_with_capacity_retry(
-    config: BallistaConfig, fn, hint: dict | None = None, **ctx_fields
+    config: BallistaConfig,
+    fn,
+    hint: dict | None = None,
+    plan_cache: dict | None = None,
+    **ctx_fields,
 ):
     """Centralized execution driver: build a TaskContext, run ``fn(ctx)``,
     raise any deferred device checks, and on a CapacityError retry with the
@@ -110,14 +152,22 @@ def run_with_capacity_retry(
     previous run grew to (key ``"agg_capacity"``) — warm re-runs of the
     same workload then start at the working capacity instead of paying the
     overflow+retry round every time."""
-    from ballista_tpu.errors import CapacityError
+    from ballista_tpu.errors import CapacityError, SpeculationMiss
 
     override: int | None = (hint or {}).get("agg_capacity")
     if override is not None and override <= config.agg_capacity():
         override = None
+    if plan_cache is not None and len(plan_cache) > 4096:
+        # bound a long-lived executor's cache across its job history; a
+        # cleared cache only costs the next run one cold strategy sync
+        plan_cache.clear()
+    spec_misses = 0
     while True:
         ctx = TaskContext(
-            config=config, agg_capacity_override=override, **ctx_fields
+            config=config,
+            agg_capacity_override=override,
+            plan_cache=plan_cache,
+            **ctx_fields,
         )
         try:
             out = fn(ctx)
@@ -127,8 +177,20 @@ def run_with_capacity_retry(
                     hint.get("agg_capacity", 0), override
                 )
             return out
+        except SpeculationMiss as e:
+            # a cached plan-shape guess went stale: invalidate + re-run
+            ctx.deferred_checks.clear()
+            ctx.speculative_checks.clear()
+            if plan_cache is not None:
+                for k in e.invalid_keys:
+                    plan_cache.pop(k, None)
+            spec_misses += 1
+            if spec_misses > 3:  # each retry removes its stale entries;
+                # >3 means something re-poisons the cache every run
+                raise
         except CapacityError as e:
             ctx.deferred_checks.clear()
+            ctx.speculative_checks.clear()
             base = override or config.agg_capacity()
             need = max(e.required + 1, base * 2)
             new_cap = 1 << (need - 1).bit_length()

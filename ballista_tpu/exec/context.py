@@ -58,6 +58,9 @@ class TpuContext(Catalog, TableProvider):
         self._mesh_checked = False
         # remembered adaptive-capacity growth (see run_with_capacity_retry)
         self._capacity_hint: dict = {}
+        # cross-query plan-shape speculation cache (join strategies,
+        # expansion capacities); cleared whenever table data changes
+        self._plan_cache: dict = {}
 
     def mesh_runtime(self):
         """The ICI collective-shuffle runtime, when this process sees >= 2
@@ -82,6 +85,10 @@ class TpuContext(Catalog, TableProvider):
         self.tables[name] = _Registered(
             "memory", schema_from_arrow(table.schema), table=table
         )
+        # data changed: cached join strategies / capacities may be stale.
+        # (They are deferred-validated anyway; clearing avoids a guaranteed
+        # speculation-miss retry on the next query over this table.)
+        self._plan_cache.clear()
 
     def register_csv(
         self,
@@ -107,6 +114,7 @@ class TpuContext(Catalog, TableProvider):
 
     def deregister_table(self, name: str) -> None:
         self.tables.pop(name, None)
+        self._plan_cache.clear()
 
     # -- Catalog / TableProvider ---------------------------------------------
     def schema_of(self, table: str) -> Schema:
@@ -264,7 +272,8 @@ class DataFrame:
         # plan with the capacity grown to the reported group count; the
         # context-level hint makes warm re-runs start at the grown size
         record_batches = run_with_capacity_retry(
-            self.ctx.config, run, hint=self.ctx._capacity_hint
+            self.ctx.config, run, hint=self.ctx._capacity_hint,
+            plan_cache=self.ctx._plan_cache
         )
         if not record_batches:
             from ballista_tpu.columnar.arrow_interop import schema_to_arrow

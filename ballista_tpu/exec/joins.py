@@ -119,6 +119,7 @@ class HashJoinExec(ExecutionPlan):
         # build-strategy flags (dups/overflow of the collected right side)
         # are partition-invariant: compute once, reuse across partitions
         self._decide_flags: tuple[bool, bool] | None = None
+        self._decide_from_cache = False
         ls, rs = left.schema(), right.schema()
         for a, b in self.on:
             if not (isinstance(a, L.Column) and isinstance(b, L.Column)):
@@ -242,13 +243,14 @@ class HashJoinExec(ExecutionPlan):
         checked inside _probe_or_expand's flag fetch), probe or expand,
         relabel the output to the plan schema."""
         bt = None
+        fp = self._strategy_key(self.right, right_keys, ctx, partition)
         for b in self.left.execute(partition, ctx):
             bb, pb = self._unify_key_dicts(build_batch, b, right_keys, left_keys)
             if bt is None or bb is not build_batch:
                 with self.metrics.time("build_time"):
                     bt = build_side(bb, right_keys)
                 build_batch = bb
-            out = self._probe_or_expand(bt, pb, left_keys, kind)
+            out = self._probe_or_expand(bt, pb, left_keys, kind, ctx, fp)
             if kind in (JoinSide.INNER, JoinSide.LEFT):
                 # probe++build == left++right; relabel to the plan schema
                 out = self._restore_column_order(out, pb, bt.batch, True)
@@ -276,12 +278,33 @@ class HashJoinExec(ExecutionPlan):
         # after dictionary unification with this partition's first probe
         # batch could disagree with partition 0 — and a disagreeing
         # partition would silently emit nothing.)
+        # The flags come from (in preference order): this plan instance, the
+        # cross-query plan cache (no sync — validated by a deferred flag;
+        # stale entries trigger an invalidate-and-retry), or a blocking
+        # fetch off a fresh build of the un-unified right side.
+        cache = ctx.plan_cache
+        fp = self._strategy_key(self.right, right_keys, ctx)
         decide = None
-        if self._decide_flags is None:
+        flags = None
+        from_cache = False
+        if cache is not None:
+            # the cache is authoritative when present — a SpeculationMiss
+            # retry invalidates IT, so the per-instance memo must not be
+            # consulted (it would replay the stale decision forever)
+            got = cache.get(fp)
+            if got is not None:
+                flags, from_cache = got, True
+        elif self._decide_flags is not None:
+            flags, from_cache = self._decide_flags, self._decide_from_cache
+        if flags is None:
             with self.metrics.time("build_time"):
                 decide = build_side(right_batch, right_keys)
-            self._decide_flags = decide.flags()
-        bt_dups, bt_ovf = self._decide_flags
+            flags = decide.flags()
+            if cache is not None:
+                cache[fp] = flags
+        self._decide_flags = flags
+        self._decide_from_cache = from_cache
+        bt_dups, bt_ovf = flags
         if bt_dups or bt_ovf:
             # Right side can't serve as a unique build (dups, or a hash-mode
             # collision run past the probe window). Deterministic across
@@ -296,9 +319,23 @@ class HashJoinExec(ExecutionPlan):
             )
             with self.metrics.time("build_time"):
                 lbt = build_side(lb, left_keys)
-            lbt_dups, lbt_ovf = lbt.flags()
+            lfp = self._strategy_key(self.left, left_keys, ctx)
+            lflags = cache.get(lfp) if cache is not None else None
+            l_from_cache = lflags is not None
+            if lflags is None:
+                lflags = lbt.flags()
+                if cache is not None:
+                    cache[lfp] = lflags
+            lbt_dups, lbt_ovf = lflags
             if not lbt_dups and not lbt_ovf:
                 # flip: build (unique) left, probe the collected right
+                if l_from_cache:
+                    ctx.defer_speculation(
+                        lbt.spec_flag(),
+                        "cached join build strategy went stale (flip side "
+                        "no longer unique)",
+                        [lfp],
+                    )
                 joined = self._probe_with_filter(
                     lbt, rb, right_keys, JoinSide.INNER
                 )
@@ -311,8 +348,17 @@ class HashJoinExec(ExecutionPlan):
             # both sides duplicated: m:n expansion, building whichever side
             # has no collision overflow (expansion needs countable runs)
             if bt_ovf and not lbt_ovf:
+                if l_from_cache:
+                    # expansion only needs countable runs: validate the
+                    # cached "no collision overflow" bit, not uniqueness
+                    ctx.defer_speculation(
+                        lbt.run_overflow,
+                        "cached join build strategy went stale (collision "
+                        "overflow appeared)",
+                        [lfp],
+                    )
                 joined = self._expand_with_filter(
-                    lbt, rb, right_keys, JoinSide.INNER
+                    lbt, rb, right_keys, JoinSide.INNER, ctx, lfp
                 )
                 out = self._restore_column_order(
                     joined, rb, lbt.batch, build_is_right=False
@@ -320,13 +366,53 @@ class HashJoinExec(ExecutionPlan):
             else:
                 with self.metrics.time("build_time"):
                     rbt = build_side(rb, right_keys)
-                rbt.check_overflow()
+                # expansion cannot count collision-overflowed runs. If the
+                # branch came from cached flags, treat a firing as a stale
+                # speculation (fresh flags may pick the other build side);
+                # otherwise it is a hard limit — defer either way (single
+                # task-boundary fetch)
+                if from_cache:
+                    ctx.defer_speculation(
+                        rbt.run_overflow,
+                        "cached join build strategy went stale (collision "
+                        "overflow appeared)",
+                        [fp],
+                    )
+                else:
+                    ctx.defer_check(
+                        rbt.run_overflow,
+                        "join build side has a packed-hash collision run "
+                        "longer than the probe window; use an integer join "
+                        "key or reduce build size",
+                    )
                 out = self._expand_with_filter(
-                    rbt, lb, left_keys, JoinSide.INNER
+                    rbt, lb, left_keys, JoinSide.INNER, ctx, fp
                 )
             self.metrics.add("output_batches")
             yield out
             return
+
+        def _validate(bt):
+            # Validation WITHOUT a sync, fetched once at the task boundary.
+            # A stale cached decision retries through the plan cache; a
+            # same-run contradiction (post-unification remapped codes
+            # introducing a collision run / apparent dups — partition-local,
+            # so no silent fallback is sound) fails loudly. Integer keys
+            # avoid packing entirely.
+            if from_cache:
+                ctx.defer_speculation(
+                    bt.spec_flag(),
+                    "cached join build strategy went stale (build side no "
+                    "longer unique)",
+                    [fp],
+                )
+            else:
+                ctx.defer_check(
+                    bt.spec_flag(),
+                    "join build side has duplicate keys or a packed-hash "
+                    "collision run after dictionary unification; use "
+                    "integer join keys",
+                )
 
         bb, pb = self._unify_key_dicts(right_batch, first, right_keys, left_keys)
         if bb is right_batch and decide is not None:
@@ -334,15 +420,7 @@ class HashJoinExec(ExecutionPlan):
         else:
             with self.metrics.time("build_time"):
                 bt = build_side(bb, right_keys)
-            # Post-unification remapped codes could in principle introduce a
-            # packed-hash collision run the original codes didn't have. The
-            # contradiction is partition-local (it depends on this
-            # partition's probe dictionary), so no silent fallback is sound
-            # — expansion can't count overflowed runs, and a per-partition
-            # branch change is exactly the silent row-drop this decision
-            # restructure removed. Raise loudly; integer keys avoid packing.
-            bt.check_unique()
-            bt.check_overflow()
+            _validate(bt)
         base = bb
 
         def _rest():
@@ -354,35 +432,92 @@ class HashJoinExec(ExecutionPlan):
             if bb2 is not base:
                 with self.metrics.time("build_time"):
                     bt = build_side(bb2, right_keys)
-                bt.check_unique()
-                bt.check_overflow()
+                _validate(bt)
                 base = bb2
             joined = self._probe_with_filter(bt, pb, left_keys, JoinSide.INNER)
             out = self._restore_column_order(joined, pb, bt.batch, True)
             self.metrics.add("output_batches")
             yield out
 
+    def _strategy_key(self, side_plan, keys: list[int], ctx, partition=None):
+        """Cross-query plan-cache key for a build side: structural plan
+        display + key indexes, scoped by job id (one executor serves many
+        jobs whose reader plans can collide structurally) and, in
+        hash-partitioned mode, by the bucket (each bucket's build data is
+        different). Purely a speculation key — staleness is caught by
+        deferred validation flags, never trusted blindly."""
+        bucket = partition if self.partition_mode == "partitioned" else None
+        return (
+            "join_flags",
+            getattr(ctx, "job_id", ""),
+            side_plan.display(),
+            tuple(keys),
+            bucket,
+        )
+
     # -- expansion (duplicate-build) path -------------------------------------
     def _probe_or_expand(
-        self, bt, probe: DeviceBatch, probe_keys: list[int], kind: JoinSide
+        self,
+        bt,
+        probe: DeviceBatch,
+        probe_keys: list[int],
+        kind: JoinSide,
+        ctx=None,
+        fp=None,
     ) -> DeviceBatch:
         """Unique build -> fixed-capacity probe; duplicated build -> m:n
         expansion (ref: DataFusion HashJoinExec m:n semantics, serde
-        physical_plan mod.rs:438-523)."""
+        physical_plan mod.rs:438-523). With a plan cache, the branch comes
+        from the cached flags with deferred validation — no blocking sync."""
+        cache = ctx.plan_cache if ctx is not None else None
+        cached = cache.get(fp) if (cache is not None and fp) else None
+        if cached is not None:
+            dups, _overflow = cached
+            if not dups:
+                ctx.defer_speculation(
+                    bt.spec_flag(),
+                    "cached join build strategy went stale (build side no "
+                    "longer unique)",
+                    [fp],
+                )
+                return self._probe_with_filter(bt, probe, probe_keys, kind)
+            # expansion also handles a unique build; only collision
+            # overflow invalidates it
+            ctx.defer_speculation(
+                bt.run_overflow,
+                "cached join build strategy went stale (collision overflow "
+                "appeared)",
+                [fp],
+            )
+            return self._expand_with_filter(
+                bt, probe, probe_keys, kind, ctx, fp
+            )
         dups, overflow = bt.flags()
+        if cache is not None and fp and not overflow:
+            # never cache an overflowing build: the overflow is a hard
+            # deterministic error below, and a cached entry would prepend a
+            # wasted speculative run to every future occurrence
+            cache[fp] = (dups, overflow)
         if overflow:
             bt.check_overflow()
         if not dups:
             return self._probe_with_filter(bt, probe, probe_keys, kind)
-        return self._expand_with_filter(bt, probe, probe_keys, kind)
+        return self._expand_with_filter(bt, probe, probe_keys, kind, ctx, fp)
 
     def _expand_with_filter(
-        self, bt, probe: DeviceBatch, probe_keys: list[int], kind: JoinSide
+        self,
+        bt,
+        probe: DeviceBatch,
+        probe_keys: list[int],
+        kind: JoinSide,
+        ctx=None,
+        fp=None,
     ) -> DeviceBatch:
         """Expansion join: count matches per probe row, size the output on
         host (bucketed static capacity), then one jitted expand+filter+
         finalize program. SEMI/ANTI never expand without a residual filter
-        (the match bit is enough)."""
+        (the match bit is enough). The output capacity sync is skipped on
+        warm runs via the plan cache (deferred-validated)."""
         with self.metrics.time("probe_time"):
             first, count, live = _jit_counts(tuple(probe_keys))(bt, probe)
 
@@ -404,9 +539,25 @@ class HashJoinExec(ExecutionPlan):
                 return fn(probe, count)
 
         preserve = kind == JoinSide.LEFT
-        with self.metrics.time("probe_time"):
-            total = int(_jit_expand_total(preserve)(probe, count))
-        out_cap = round_capacity(max(total, 1))
+        cache = ctx.plan_cache if ctx is not None else None
+        cap_key = ("expand_cap", fp, kind.name) if fp else None
+        out_cap = cache.get(cap_key) if (cache is not None and cap_key) else None
+        if out_cap is not None:
+            # warm path: reuse the last run's capacity, validate on device
+            # (rides the task-boundary fetch); a grown join output triggers
+            # invalidate-and-retry, which re-syncs and re-caches
+            total_dev = _jit_expand_total(preserve)(probe, count)
+            ctx.defer_speculation(
+                total_dev > out_cap,
+                "cached expansion-join capacity went stale (output grew)",
+                [cap_key],
+            )
+        else:
+            with self.metrics.time("probe_time"):
+                total = int(_jit_expand_total(preserve)(probe, count))
+            out_cap = round_capacity(max(total, 1))
+            if cache is not None and cap_key:
+                cache[cap_key] = max(out_cap, cache.get(cap_key) or 0)
 
         key = (tuple(probe_keys), kind, out_cap)
         fn = self._filtered_probe_cache.get(key)

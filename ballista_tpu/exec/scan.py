@@ -44,7 +44,7 @@ class MemoryScanExec(ExecutionPlan):
         out_schema: Schema,
         projection: list[str] | None = None,
         partitions: int = 1,
-        batch_rows: int = 1 << 17,
+        batch_rows: int = 1 << 20,
         device_cache: dict | None = None,
     ) -> None:
         """``device_cache``: an (optionally shared, table-lifetime) dict the
@@ -119,7 +119,7 @@ class CsvScanExec(ExecutionPlan):
         delimiter: str = ",",
         projection: list[str] | None = None,
         partitions: int = 1,
-        batch_rows: int = 1 << 17,
+        batch_rows: int = 1 << 20,
     ) -> None:
         super().__init__()
         self.path = path
@@ -301,7 +301,7 @@ class ParquetScanExec(ExecutionPlan):
         table_schema: Schema,
         projection: list[str] | None = None,
         partitions: int = 1,
-        batch_rows: int = 1 << 17,
+        batch_rows: int = 1 << 20,
         predicates: list | None = None,
     ) -> None:
         super().__init__()

@@ -47,6 +47,7 @@ class Executor:
         self.codec = BallistaCodec(provider=provider)
         # adaptive-capacity memory across tasks (run_with_capacity_retry)
         self._capacity_hint: dict = {}
+        self._plan_cache: dict = {}
         from ballista_tpu.executor.metrics import LoggingMetricsCollector
 
         self.metrics_collector = metrics_collector or LoggingMetricsCollector()
@@ -79,6 +80,7 @@ class Executor:
                 task.task_id.partition_id, ctx
             ),
             hint=self._capacity_hint,
+            plan_cache=self._plan_cache,
             session_id=task.session_id,
             job_id=task.task_id.job_id,
             work_dir=self.work_dir,

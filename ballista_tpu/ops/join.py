@@ -142,12 +142,20 @@ class BuildTable:
             has_dups=has_dups, run_overflow=run_overflow,
         )
 
+    def spec_flag(self):
+        """Device bool: this build cannot serve as a unique-key probe table
+        (dups or collision-run overflow). Used for deferred validation of
+        cached build-strategy decisions — no host sync."""
+        return jnp.logical_or(self.has_dups, self.run_overflow)
+
     def flags(self) -> tuple[bool, bool]:
         """(has_dups, run_overflow) fetched in ONE device round-trip and
         cached (each scalar sync costs ~100ms over a tunnelled TPU)."""
         cached = getattr(self, "_flags_cache", None)
         if cached is None:
-            d, o = jax.device_get((self.has_dups, self.run_overflow))
+            from ballista_tpu.ops.fetch import fetch_arrays
+
+            d, o = fetch_arrays([self.has_dups, self.run_overflow])
             cached = (bool(d), bool(o))
             object.__setattr__(self, "_flags_cache", cached)
         return cached

@@ -102,7 +102,9 @@ class MeshStageRunner:
             out_cols, out_nulls, out_valid, grp_ovf, need = prog(
                 batch.columns, batch.nulls, batch.valid
             )
-            grp_ovf, need = jax.device_get((grp_ovf, need))
+            from ballista_tpu.ops.fetch import fetch_arrays
+
+            grp_ovf, need = fetch_arrays([grp_ovf, need])
             if not np.any(grp_ovf):
                 break
             required = int(np.max(need))
@@ -297,8 +299,10 @@ class MeshStageRunner:
                 left.columns, left.nulls, left.valid,
                 right.columns, right.nulls, right.valid,
             )
-            bucket_ovf, run_ovf, exp_ovf, totals = jax.device_get(
-                (bucket_ovf, run_ovf, exp_ovf, totals)
+            from ballista_tpu.ops.fetch import fetch_arrays
+
+            bucket_ovf, run_ovf, exp_ovf, totals = fetch_arrays(
+                [bucket_ovf, run_ovf, exp_ovf, totals]
             )
             if np.any(run_ovf):
                 raise ExecutionError(

@@ -45,7 +45,14 @@ def run_suite() -> dict:
     t0 = time.time()
     data = gen_all(scale=SF)
     gen_s = time.time() - t0
-    ctx = TpuContext()
+    from ballista_tpu.config import BallistaConfig
+
+    # single-chip suite: host-side partition splitting only multiplies
+    # blocking syncs (the XLA program parallelizes internally); distributed
+    # partitioning is exercised by the cluster tests, not the chip bench
+    ctx = TpuContext(
+        BallistaConfig().with_setting("ballista.shuffle.partitions", "1")
+    )
     rows = {}
     for name, t in data.items():
         ctx.register_table(name, t)
