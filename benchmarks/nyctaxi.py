@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""NYC taxi benchmark.
+
+ref benchmarks/src/bin/nyctaxi.rs:65-134 — registers the tripdata CSV and
+runs the `fare_amt_by_passenger` aggregate N times, printing per-iteration
+timings. The reference reads a downloaded tripdata CSV; this environment
+has no egress, so a deterministic synthetic generator produces data with
+the reference's schema (:136-157) — pass ``--data <csv>`` to use a real
+tripdata file instead.
+
+Usage: python benchmarks/nyctaxi.py [--rows N] [--iterations N] [--data csv]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+QUERIES = {
+    # ref :104-105
+    "fare_amt_by_passenger": (
+        "SELECT passenger_count, MIN(fare_amount), MAX(fare_amount), "
+        "SUM(fare_amount) FROM tripdata GROUP BY passenger_count"
+    ),
+}
+
+
+def gen_tripdata(rows: int):
+    """Synthetic tripdata with the reference's column layout (:136-157)."""
+    import numpy as np
+    import pyarrow as pa
+
+    r = np.random.default_rng(7)
+    fare = np.round(r.gamma(2.2, 6.0, rows), 2)
+    tip = np.round(fare * r.uniform(0, 0.3, rows), 2)
+    tolls = np.where(r.uniform(0, 1, rows) < 0.05, 6.55, 0.0)
+    return pa.table(
+        {
+            "VendorID": pa.array(
+                [str(v) for v in r.integers(1, 3, rows)]
+            ),
+            "passenger_count": pa.array(
+                r.integers(1, 7, rows).astype("int32")
+            ),
+            "trip_distance": pa.array(
+                [f"{d:.2f}" for d in r.gamma(1.8, 1.7, rows)]
+            ),
+            "payment_type": pa.array(
+                [str(v) for v in r.integers(1, 5, rows)]
+            ),
+            "fare_amount": pa.array(fare),
+            "extra": pa.array(np.where(r.uniform(0, 1, rows) < 0.5, 0.5, 0.0)),
+            "mta_tax": pa.array(np.full(rows, 0.5)),
+            "tip_amount": pa.array(tip),
+            "tolls_amount": pa.array(tolls),
+            "improvement_surcharge": pa.array(np.full(rows, 0.3)),
+            "total_amount": pa.array(
+                np.round(fare + tip + tolls + 1.3, 2)
+            ),
+        }
+    )
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description="nyctaxi benchmark")
+    p.add_argument("--rows", type=int, default=1_000_000)
+    p.add_argument("--iterations", type=int, default=3)
+    p.add_argument("--data", help="real tripdata CSV (default: synthetic)")
+    args = p.parse_args()
+
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.exec.context import TpuContext
+
+    ctx = TpuContext(
+        BallistaConfig().with_setting("ballista.shuffle.partitions", "1")
+    )
+    if args.data:
+        ctx.sql(
+            "create external table tripdata stored as csv "
+            f"with header row location '{args.data}'"
+        )
+    else:
+        t0 = time.time()
+        ctx.register_table("tripdata", gen_tripdata(args.rows))
+        print(f"generated {args.rows} rows in {time.time() - t0:.2f}s")
+
+    for name, sql in QUERIES.items():
+        print(f"Executing '{name}'")
+        for i in range(args.iterations):
+            start = time.time()
+            res = ctx.sql(sql).collect()
+            ms = (time.time() - start) * 1000
+            print(f"Query '{name}' iteration {i} took {ms:.0f} ms "
+                  f"({res.num_rows} rows)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
