@@ -35,6 +35,7 @@ BALLISTA_PLUGIN_DIR = "ballista.plugin_dir"
 # TPU-native extensions.
 BALLISTA_DEVICE = "ballista.tpu.device"  # "tpu" | "cpu" | "auto"
 BALLISTA_AGG_CAPACITY = "ballista.tpu.agg_capacity"  # max distinct groups per kernel
+BALLISTA_PROFILE_DIR = "ballista.tpu.profile_dir"  # XLA profiler trace output
 BALLISTA_JOIN_EXPANSION = "ballista.tpu.join_expansion"  # probe-output expansion factor
 BALLISTA_COLLECTIVE_SHUFFLE = "ballista.tpu.collective_shuffle"  # on-pod all_to_all
 
@@ -115,6 +116,14 @@ def _entries() -> dict[str, ConfigEntry]:
             _parse_bool,
         ),
         ConfigEntry(BALLISTA_PLUGIN_DIR, "UDF plugin directory", "", str),
+        ConfigEntry(
+            BALLISTA_PROFILE_DIR,
+            "When set, wrap task execution in jax.profiler.trace writing "
+            "TensorBoard-compatible device traces here (SURVEY §5 tracing: "
+            "the XLA profiler hook beside per-op host metrics)",
+            "",
+            str,
+        ),
         ConfigEntry(BALLISTA_DEVICE, "Execution device: tpu|cpu|auto", "auto", str),
         ConfigEntry(
             BALLISTA_AGG_CAPACITY,
@@ -215,6 +224,9 @@ class BallistaConfig:
 
     def agg_capacity(self) -> int:
         return self._get(BALLISTA_AGG_CAPACITY)
+
+    def profile_dir(self) -> str:
+        return self._get(BALLISTA_PROFILE_DIR)
 
     def join_expansion(self) -> int:
         return self._get(BALLISTA_JOIN_EXPANSION)

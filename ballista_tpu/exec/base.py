@@ -132,6 +132,11 @@ class TaskContext:
 # query needs a hash-repartitioned (multi-partition) aggregate instead.
 AGG_CAPACITY_HARD_MAX = 1 << 23
 
+# Guards the process-global JAX profiler (see run_with_capacity_retry).
+import threading as _threading  # noqa: E402
+
+_PROFILER_LOCK = _threading.Lock()
+
 
 def run_with_capacity_retry(
     config: BallistaConfig,
@@ -170,7 +175,23 @@ def run_with_capacity_retry(
             **ctx_fields,
         )
         try:
-            out = fn(ctx)
+            profile_dir = config.profile_dir()
+            # the JAX profiler is process-global (one active trace); with
+            # concurrent executor tasks only the first gets traced, the
+            # rest run unprofiled rather than failing
+            if profile_dir and _PROFILER_LOCK.acquire(blocking=False):
+                try:
+                    # SURVEY §5 tracing: device-time profiling via the
+                    # XLA/JAX profiler, wrapping exactly one task attempt
+                    # (TensorBoard reads the trace dir)
+                    import jax
+
+                    with jax.profiler.trace(profile_dir):
+                        out = fn(ctx)
+                finally:
+                    _PROFILER_LOCK.release()
+            else:
+                out = fn(ctx)
             ctx.raise_deferred()
             if override is not None and hint is not None:
                 hint["agg_capacity"] = max(

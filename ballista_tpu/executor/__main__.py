@@ -47,8 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
         help="Flight data-plane port",
     )
     p.add_argument(
-        "--bind-grpc-port", type=int, default=int(_env("bind_grpc_port", 50052)),
-        help="push-mode control port (LaunchTask)",
+        "--bind-grpc-port", type=int, default=int(_env("bind_grpc_port", 50053)),
+        help="push-mode control port (LaunchTask); 50052 is the "
+        "scheduler's conventional REST port, so default past it",
     )
     p.add_argument("--scheduler-host", default=_env("scheduler_host", "localhost"))
     p.add_argument(

@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""TPC-H benchmark harness with the reference's CLI shape.
+
+ref benchmarks/src/bin/tpch.rs:69-260 — subcommands:
+
+  tpch benchmark ballista   --query N --path DIR [--format csv|parquet]
+                            [--host H --port P] [--iterations I]
+                            [--partitions N] [--batch-size S] [--debug]
+                            [--output DIR]
+  tpch benchmark datafusion --query N --path DIR ...   (local engine)
+  tpch convert              --input DIR --output DIR --format parquet
+  tpch loadtest ballista    --query-list 1,6 --path DIR --requests R
+                            --concurrency C [--host H --port P]
+
+plus a ``gen`` subcommand standing in for the reference's dockerised
+dbgen (benchmarks/tpch-gen.sh — no egress here):
+
+  tpch gen --scale 0.1 --path DIR [--format csv|parquet]
+
+Data layout: ``<path>/<table>.<ext>`` for the 8 TPC-H tables. The summary
+JSON mirrors write_summary_json (tpch.rs:407-418).
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import sys
+import time
+from pathlib import Path
+
+HERE = Path(__file__).resolve().parent
+sys.path.insert(0, str(HERE.parent))
+
+TABLES = (
+    "part", "supplier", "partsupp", "customer",
+    "orders", "lineitem", "nation", "region",
+)
+
+
+def _query_sql(n: int) -> str:
+    qfile = HERE / "queries" / f"q{n}.sql"
+    return qfile.read_text()
+
+
+def _make_context(args, remote_ok: bool = True):
+    from ballista_tpu.config import BallistaConfig
+
+    config = BallistaConfig().with_setting(
+        "ballista.shuffle.partitions", str(args.partitions)
+    )
+    if args.batch_size:
+        config = config.with_setting(
+            "ballista.batch.size", str(args.batch_size)
+        )
+    if remote_ok and args.host and args.port:
+        from ballista_tpu.client.context import BallistaContext
+
+        return BallistaContext.remote(args.host, args.port, config)
+    from ballista_tpu.exec.context import TpuContext
+
+    return TpuContext(config)
+
+
+def _register_tables(ctx, path: str, file_format: str) -> None:
+    from ballista_tpu.tpch import all_schemas
+
+    schemas = all_schemas()
+    for t in TABLES:
+        f = Path(path) / f"{t}.{file_format}"
+        if not f.exists():
+            raise SystemExit(f"missing table file {f}")
+        if file_format == "csv":
+            ctx.register_csv(t, str(f), schema=schemas[t], has_header=True)
+        else:
+            ctx.register_parquet(t, str(f))
+
+
+def _write_summary(output: str | None, run: dict) -> None:
+    """ref write_summary_json (tpch.rs:407-418): one timestamped JSON."""
+    if not output:
+        return
+    out = Path(output)
+    out.mkdir(parents=True, exist_ok=True)
+    f = out / f"tpch-summary--{int(time.time())}.json"
+    f.write_text(json.dumps(run, indent=2))
+    print(f"Summary written to: {f}")
+
+
+def cmd_benchmark(args) -> int:
+    ctx = _make_context(args, remote_ok=args.engine == "ballista")
+    _register_tables(ctx, args.path, args.format)
+    sql = _query_sql(args.query)
+    run = {
+        "engine": args.engine,
+        "query": args.query,
+        "iterations": [],
+        "start_time": int(time.time()),
+    }
+    for i in range(args.iterations):
+        t0 = time.time()
+        res = ctx.sql(sql).collect()
+        ms = (time.time() - t0) * 1000
+        run["iterations"].append({"elapsed_ms": ms, "rows": res.num_rows})
+        print(
+            f"Query {args.query} iteration {i} took {ms:.1f} ms "
+            f"and returned {res.num_rows} rows"
+        )
+        if args.debug:
+            print(res.to_pandas().to_string(index=False))
+    best = min(it["elapsed_ms"] for it in run["iterations"])
+    print(f"Query {args.query} best time: {best:.1f} ms")
+    _write_summary(args.output, run)
+    if hasattr(ctx, "close"):
+        ctx.close()
+    return 0
+
+
+def cmd_loadtest(args) -> int:
+    """ref BallistaLoadtestOpt (tpch.rs:155-199): fire R requests over C
+    concurrent clients round-robining the query list."""
+    queries = [int(q) for q in args.query_list.split(",")]
+
+    def one(i: int) -> float:
+        ctx = _make_context(args)
+        _register_tables(ctx, args.path, args.format)
+        t0 = time.time()
+        ctx.sql(_query_sql(queries[i % len(queries)])).collect()
+        dt = time.time() - t0
+        if hasattr(ctx, "close"):
+            ctx.close()
+        return dt
+
+    t0 = time.time()
+    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as pool:
+        times = list(pool.map(one, range(args.requests)))
+    total = time.time() - t0
+    print(
+        f"loadtest: {args.requests} requests x q[{args.query_list}] in "
+        f"{total:.1f}s ({args.requests / total:.2f} req/s, "
+        f"mean {sum(times) / len(times) * 1000:.0f} ms)"
+    )
+    return 0
+
+
+def cmd_convert(args) -> int:
+    """ref ConvertOpt (tpch.rs:201-227): csv -> parquet. Column types come
+    from the engine's TPC-H schemas, not CSV inference, so converted files
+    match what the benchmark queries assume."""
+    import pyarrow.csv as pacsv
+    import pyarrow.parquet as papq
+
+    from ballista_tpu.columnar.arrow_interop import schema_to_arrow
+    from ballista_tpu.tpch import all_schemas
+
+    schemas = all_schemas()
+    out = Path(args.output)
+    out.mkdir(parents=True, exist_ok=True)
+    for t in TABLES:
+        src = Path(args.input) / f"{t}.csv"
+        if not src.exists():
+            print(f"skipping {t} (no {src})")
+            continue
+        arrow_schema = schema_to_arrow(schemas[t])
+        table = pacsv.read_csv(
+            str(src),
+            convert_options=pacsv.ConvertOptions(
+                column_types={f.name: f.type for f in arrow_schema}
+            ),
+        )
+        papq.write_table(table, str(out / f"{t}.parquet"))
+        print(f"converted {t}: {table.num_rows} rows")
+    return 0
+
+
+def cmd_gen(args) -> int:
+    import pyarrow.csv as pacsv
+    import pyarrow.parquet as papq
+
+    from ballista_tpu.tpch import gen_all
+
+    out = Path(args.path)
+    out.mkdir(parents=True, exist_ok=True)
+    data = gen_all(scale=args.scale)
+    for t, table in data.items():
+        f = out / f"{t}.{args.format}"
+        if args.format == "csv":
+            pacsv.write_csv(table, str(f))
+        else:
+            papq.write_table(table, str(f))
+        print(f"wrote {f} ({table.num_rows} rows)")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpch", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    bench = sub.add_parser("benchmark")
+    bsub = bench.add_subparsers(dest="engine", required=True)
+    for engine in ("ballista", "datafusion"):
+        b = bsub.add_parser(engine)
+        b.add_argument("-q", "--query", type=int, required=True)
+        b.add_argument("-d", "--debug", action="store_true")
+        b.add_argument("-i", "--iterations", type=int, default=3)
+        b.add_argument("-s", "--batch-size", type=int, default=0)
+        b.add_argument("-p", "--path", required=True)
+        b.add_argument("-f", "--format", default="csv",
+                       choices=["csv", "parquet"])
+        b.add_argument("-n", "--partitions", type=int, default=2)
+        b.add_argument("--host")
+        b.add_argument("--port", type=int)
+        b.add_argument("-o", "--output")
+        b.set_defaults(fn=cmd_benchmark)
+
+    lt = sub.add_parser("loadtest")
+    ltsub = lt.add_subparsers(dest="engine", required=True)
+    l = ltsub.add_parser("ballista")
+    l.add_argument("-q", "--query-list", required=True)
+    l.add_argument("-r", "--requests", type=int, default=100)
+    l.add_argument("-c", "--concurrency", type=int, default=5)
+    l.add_argument("-n", "--partitions", type=int, default=2)
+    l.add_argument("-s", "--batch-size", type=int, default=0)
+    l.add_argument("-p", "--data-path", dest="path", required=True)
+    l.add_argument("-f", "--format", default="csv",
+                   choices=["csv", "parquet"])
+    l.add_argument("--host")
+    l.add_argument("--port", type=int)
+    l.set_defaults(fn=cmd_loadtest)
+
+    cv = sub.add_parser("convert")
+    cv.add_argument("-i", "--input", required=True)
+    cv.add_argument("-o", "--output", required=True)
+    cv.add_argument("-f", "--format", default="parquet",
+                    choices=["parquet"])
+    cv.set_defaults(fn=cmd_convert)
+
+    g = sub.add_parser("gen")
+    g.add_argument("--scale", type=float, default=0.01)
+    g.add_argument("-p", "--path", required=True)
+    g.add_argument("-f", "--format", default="csv",
+                   choices=["csv", "parquet"])
+    g.set_defaults(fn=cmd_gen)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
