@@ -206,6 +206,32 @@ class PhysicalPlanner:
 
     def _plan_join(self, node: P.Join) -> ExecutionPlan:
         jt = node.join_type
+        if jt == P.JoinType.FULL:
+            # FULL = LEFT(l,r) UNION ALL (r ANTI-join l, left columns padded
+            # with typed NULLs). The ANTI side carries the residual filter:
+            # a right row is unmatched when no pair passed equi+filter.
+            # Known cost: both input subtrees execute twice (once per
+            # branch); a native full-outer probe sharing one build table
+            # would halve that — acceptable until FULL shows up hot.
+            left_part = P.Join(
+                node.left, node.right, node.on, P.JoinType.LEFT, node.filter
+            )
+            anti_part = P.Join(
+                node.right, node.left,
+                tuple((b, a) for a, b in node.on),
+                P.JoinType.ANTI, node.filter,
+            )
+            a = self._plan_join(left_part)
+            b = self._plan_join(anti_part)
+            ls = node.left.schema()
+            rs = node.right.schema()
+            pad = [
+                L.Alias(L.Literal(None, f.dtype), f.name) for f in ls
+            ] + [L.Column(f.name) for f in rs]
+            padded = ProjectionExec(b, pad)
+            # the LEFT branch already has node.schema()'s names in order —
+            # no identity projection needed
+            return UnionExec([a, padded])
         if jt == P.JoinType.RIGHT:
             # flip to LEFT; column order restored by a projection
             flipped = P.Join(

@@ -28,9 +28,16 @@ def resolve_field_index(schema: Schema, name: str) -> int:
     ``alias.name`` fields), then unique base-name match (``table.name``
     against bare fields — tables referenced without an alias produce
     unqualified schemas)."""
-    for i, f in enumerate(schema.fields):
-        if f.name == name:
-            return i
+    exact = [i for i, f in enumerate(schema.fields) if f.name == name]
+    if len(exact) == 1:
+        return exact[0]
+    if len(exact) > 1:
+        # duplicate field names (an unqualifiable join collision, or an
+        # unaliased self-join): refuse rather than silently pick a side
+        raise SchemaError(
+            f"ambiguous column {name!r}: appears {len(exact)} times; "
+            "qualify it or alias the tables"
+        )
     if "." not in name:
         hits = [
             i for i, f in enumerate(schema.fields) if f.name.endswith("." + name)

@@ -144,9 +144,24 @@ def _compile_literal(expr: L.Literal):
     def fn(batch: DeviceBatch) -> ColumnValue:
         cap = batch.capacity
         if expr.value is None:
+            if dtype == DataType.NULL:
+                return ColumnValue(
+                    jnp.zeros(cap, dtype=bool), jnp.ones(cap, dtype=bool),
+                    DataType.NULL,
+                )
+            # typed NULL (e.g. the FULL-join padding columns): carrier
+            # zeros of the declared dtype under an all-null mask
+            if dtype == DataType.STRING:
+                return ColumnValue(
+                    jnp.zeros(cap, dtype=jnp.int32),
+                    jnp.ones(cap, dtype=bool),
+                    dtype,
+                    Dictionary(()),
+                )
             return ColumnValue(
-                jnp.zeros(cap, dtype=bool), jnp.ones(cap, dtype=bool),
-                DataType.NULL,
+                jnp.zeros(cap, dtype=dtype.to_np()),
+                jnp.ones(cap, dtype=bool),
+                dtype,
             )
         if dtype == DataType.STRING:
             return ColumnValue(

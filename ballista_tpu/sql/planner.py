@@ -223,6 +223,23 @@ class SqlPlanner:
         if isinstance(ref, ast.JoinClause):
             left = self.plan_table_ref(ref.left)
             right = self.plan_table_ref(ref.right)
+            # bare column-name collisions (e.g. both sides have `id1`) make
+            # the joined schema unresolvable; qualify each colliding side
+            # with its table name so `x.id1` resolves exactly and a bare
+            # `id1` correctly reports ambiguity (DataFusion gets this from
+            # qualified DFSchema fields; here qualification is opt-in at
+            # the collision site to keep TPC-H-style disjoint schemas bare)
+            lnames = {f.name for f in left.schema().fields}
+            rnames = {f.name for f in right.schema().fields}
+            if lnames & rnames:
+                ql = self._qualify(left, ref.left)
+                qr = self._qualify(right, ref.right)
+                # all-or-nothing: qualifying only one side would let the
+                # bare name silently resolve to the unqualified side; left
+                # unqualified on BOTH sides, the duplicate-exact-match check
+                # in resolve_field_index reports ambiguity instead
+                if ql is not left and qr is not right:
+                    left, right = ql, qr
             if ref.kind == "cross":
                 return CrossJoin(left, right)
             jt = {
@@ -246,6 +263,21 @@ class SqlPlanner:
                 return plan
             return Join(left, right, tuple(on_pairs), jt, residual)
         raise PlanError(f"unsupported table ref {type(ref).__name__}")
+
+    @staticmethod
+    def _qualify(plan: LogicalPlan, ref: ast.TableRef) -> LogicalPlan:
+        """Wrap a join input in SubqueryAlias so its fields carry a
+        ``table.`` prefix — only when not already qualified."""
+        name = None
+        if isinstance(ref, ast.Relation):
+            name = ref.alias or ref.name
+        elif isinstance(ref, ast.Derived):
+            name = ref.alias
+        if name is None:
+            return plan  # nested join etc. — already a mix, leave as-is
+        if any("." in f.name for f in plan.schema().fields):
+            return plan  # already qualified (explicit alias)
+        return SubqueryAlias(plan, name)
 
     def _extract_equi_keys(
         self, cond: L.Expr | None, ls: Schema, rs: Schema

@@ -37,10 +37,9 @@ GROUPBY_QUERIES = {
            "COUNT(*) AS cnt FROM x GROUP BY id1, id2, id3, id4, id5, id6",
 }
 
-# ref join-datafusion.py selects x.id1 qualified; qualified SELECT-list
-# names over a duplicated join column aren't resolvable yet, so project
-# the unambiguous columns (same scan/join/projection work)
-JOIN_QUERY = "SELECT v1, v2 FROM x JOIN small ON x.id1 = small.id1"
+JOIN_QUERY = (
+    "SELECT x.id1, x.v1, small.v2 FROM x JOIN small ON x.id1 = small.id1"
+)
 
 
 def gen_g1(n: int, k: int):
@@ -54,11 +53,13 @@ def gen_g1(n: int, k: int):
             "id1": pa.array([f"id{v:03d}" for v in r.integers(1, k + 1, n)]),
             "id2": pa.array([f"id{v:03d}" for v in r.integers(1, k + 1, n)]),
             "id3": pa.array(
-                [f"id{v:010d}" for v in r.integers(1, n // k + 1, n)]
+                [f"id{v:010d}" for v in r.integers(1, max(n // k, 1) + 1, n)]
             ),
             "id4": pa.array(r.integers(1, k + 1, n).astype("int64")),
             "id5": pa.array(r.integers(1, k + 1, n).astype("int64")),
-            "id6": pa.array(r.integers(1, n // k + 1, n).astype("int64")),
+            "id6": pa.array(
+                r.integers(1, max(n // k, 1) + 1, n).astype("int64")
+            ),
             "v1": pa.array(r.integers(1, 6, n).astype("int64")),
             "v2": pa.array(r.integers(1, 16, n).astype("int64")),
             "v3": pa.array(np.round(r.uniform(0, 100, n), 6)),
