@@ -58,6 +58,22 @@ res = ctx.sql(
 ).collect().to_pandas()
 assert len(res) == len(lp) + len(rp) == 7
 assert res.a.isna().sum() == len(rp) and res.b.isna().sum() == len(lp)
+
+# the same queries through the distributed cluster (serde + stage
+# decomposition of the UNION/ANTI decomposition)
+from ballista_tpu.client.context import BallistaContext
+cctx = BallistaContext.standalone()
+cctx.register_table("l", l)
+cctx.register_table("r", r)
+res = cctx.sql(
+    "SELECT k, a, j, b FROM l FULL JOIN r ON k = j"
+).collect().to_pandas()
+assert len(res) == 5 and res.j.isna().sum() == 1, res
+res = cctx.sql(
+    "SELECT k, a, j, b FROM l RIGHT JOIN r ON k = j"
+).collect().to_pandas()
+assert len(res) == 4, res
+cctx.close()
 print("OUTER-JOIN-OK")
 """
 
