@@ -89,12 +89,75 @@ def run_suite() -> dict:
     return out
 
 
+def _run_child(env: dict, iters: int, timeout: int, label: str):
+    """Run one suite in a child process, returning its parsed result dict
+    or None. Shared by the device and CPU phases; captures partial output
+    on timeout (the wedged-TPU diagnosis) and tolerates trailing non-JSON
+    stdout noise from library atexit handlers."""
+    env = dict(env)
+    env.update(
+        {
+            "BENCH_CHILD": "1",
+            "BENCH_SF": str(SF),
+            "BENCH_ITERS": str(iters),
+            "BENCH_QUERIES": ",".join(QUERIES),
+        }
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(HERE / "bench.py")],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired as e:
+        tail = e.stderr or ""
+        if isinstance(tail, bytes):
+            tail = tail.decode(errors="replace")
+        print(
+            f"{label} suite exceeded {timeout}s (wedged TPU runtime?); "
+            f"partial stderr:\n{tail[-3000:]}",
+            file=sys.stderr,
+        )
+        return None
+    if proc.returncode != 0:
+        print(f"{label} suite failed:\n{proc.stderr[-4000:]}", file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.strip().splitlines() or [""]):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    print(f"{label} suite produced no JSON:\n{proc.stdout[-2000:]}",
+          file=sys.stderr)
+    return None
+
+
 def main() -> None:
     if os.environ.get("BENCH_CHILD"):
         print(json.dumps(run_suite()))
         return
 
-    device_run = run_suite()
+    # The device suite runs in a SUBPROCESS with a hard timeout: a wedged
+    # TPU tunnel (observed: any device op hanging indefinitely) must fail
+    # this harness loudly instead of hanging the driver forever.
+    device_env = dict(os.environ)
+    # PREPEND to PYTHONPATH: clobbering it would break the axon platform
+    # plugin the site config registers from it
+    device_env["PYTHONPATH"] = os.pathsep.join(
+        [str(HERE)]
+        + ([os.environ["PYTHONPATH"]] if os.environ.get("PYTHONPATH") else [])
+    )
+    device_run = _run_child(
+        device_env,
+        ITERS,
+        int(os.environ.get("BENCH_DEVICE_TIMEOUT", 2700)),
+        "device",
+    )
+    if device_run is None:
+        raise SystemExit(1)
 
     cpu_run = None
     if not os.environ.get("BENCH_SKIP_CPU"):
@@ -103,30 +166,9 @@ def main() -> None:
             for k, v in os.environ.items()
             if not k.startswith(("PALLAS_AXON", "AXON"))
         }
-        env.update(
-            {
-                "BENCH_CHILD": "1",
-                "JAX_PLATFORMS": "cpu",
-                "PYTHONPATH": str(HERE),
-                "BENCH_SF": str(SF),
-                "BENCH_ITERS": str(max(1, ITERS - 2)),
-                "BENCH_QUERIES": ",".join(QUERIES),
-            }
-        )
-        try:
-            proc = subprocess.run(
-                [sys.executable, str(HERE / "bench.py")],
-                env=env,
-                capture_output=True,
-                text=True,
-                timeout=3600,
-            )
-            if proc.returncode == 0:
-                cpu_run = json.loads(proc.stdout.strip().splitlines()[-1])
-            else:
-                print(proc.stderr[-2000:], file=sys.stderr)
-        except Exception as e:  # CPU baseline is best-effort
-            print(f"cpu baseline failed: {e}", file=sys.stderr)
+        env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": str(HERE)})
+        # CPU baseline is best-effort: a failure degrades vs_baseline to 0
+        cpu_run = _run_child(env, max(1, ITERS - 2), 3600, "cpu")
 
     detail = {"device": device_run, "cpu": cpu_run}
     (HERE / "BENCH_DETAIL.json").write_text(json.dumps(detail, indent=2))
