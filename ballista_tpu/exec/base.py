@@ -58,6 +58,10 @@ class TaskContext:
     plan_cache: dict | None = None
     # validation flags for plan_cache entries: (flag, message, cache_keys)
     speculative_checks: list = dataclasses.field(default_factory=list)
+    # per-run scratch (e.g. which cache keys THIS run has already synced:
+    # later batches of the same run must keep syncing/maxing, not
+    # speculate against a value a smaller earlier batch just wrote)
+    run_state: dict = dataclasses.field(default_factory=dict)
 
     def defer_check(self, flag, message: str, required=None) -> None:
         """Queue a device bool ``flag``; if it fires at the task boundary the

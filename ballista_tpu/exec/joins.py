@@ -250,7 +250,9 @@ class HashJoinExec(ExecutionPlan):
                 with self.metrics.time("build_time"):
                     bt = build_side(bb, right_keys)
                 build_batch = bb
-            out = self._probe_or_expand(bt, pb, left_keys, kind, ctx, fp)
+            out = self._probe_or_expand(
+                bt, pb, left_keys, kind, ctx, fp, partition
+            )
             if kind in (JoinSide.INNER, JoinSide.LEFT):
                 # probe++build == left++right; relabel to the plan schema
                 out = self._restore_column_order(out, pb, bt.batch, True)
@@ -358,7 +360,7 @@ class HashJoinExec(ExecutionPlan):
                         [lfp],
                     )
                 joined = self._expand_with_filter(
-                    lbt, rb, right_keys, JoinSide.INNER, ctx, lfp
+                    lbt, rb, right_keys, JoinSide.INNER, ctx, lfp, 0
                 )
                 out = self._restore_column_order(
                     joined, rb, lbt.batch, build_is_right=False
@@ -386,7 +388,7 @@ class HashJoinExec(ExecutionPlan):
                         "key or reduce build size",
                     )
                 out = self._expand_with_filter(
-                    rbt, lb, left_keys, JoinSide.INNER, ctx, fp
+                    rbt, lb, left_keys, JoinSide.INNER, ctx, fp, 0
                 )
             self.metrics.add("output_batches")
             yield out
@@ -464,6 +466,7 @@ class HashJoinExec(ExecutionPlan):
         kind: JoinSide,
         ctx=None,
         fp=None,
+        partition: int = 0,
     ) -> DeviceBatch:
         """Unique build -> fixed-capacity probe; duplicated build -> m:n
         expansion (ref: DataFusion HashJoinExec m:n semantics, serde
@@ -490,7 +493,7 @@ class HashJoinExec(ExecutionPlan):
                 [fp],
             )
             return self._expand_with_filter(
-                bt, probe, probe_keys, kind, ctx, fp
+                bt, probe, probe_keys, kind, ctx, fp, partition
             )
         dups, overflow = bt.flags()
         if cache is not None and fp and not overflow:
@@ -502,7 +505,9 @@ class HashJoinExec(ExecutionPlan):
             bt.check_overflow()
         if not dups:
             return self._probe_with_filter(bt, probe, probe_keys, kind)
-        return self._expand_with_filter(bt, probe, probe_keys, kind, ctx, fp)
+        return self._expand_with_filter(
+            bt, probe, probe_keys, kind, ctx, fp, partition
+        )
 
     def _expand_with_filter(
         self,
@@ -512,6 +517,7 @@ class HashJoinExec(ExecutionPlan):
         kind: JoinSide,
         ctx=None,
         fp=None,
+        partition: int = 0,
     ) -> DeviceBatch:
         """Expansion join: count matches per probe row, size the output on
         host (bucketed static capacity), then one jitted expand+filter+
@@ -540,12 +546,20 @@ class HashJoinExec(ExecutionPlan):
 
         preserve = kind == JoinSide.LEFT
         cache = ctx.plan_cache if ctx is not None else None
-        cap_key = ("expand_cap", fp, kind.name) if fp else None
+        cap_key = ("expand_cap", fp, kind.name, partition) if fp else None
         out_cap = cache.get(cap_key) if (cache is not None and cap_key) else None
-        if out_cap is not None:
-            # warm path: reuse the last run's capacity, validate on device
+        synced = (
+            ctx.run_state.setdefault("synced_caps", set())
+            if ctx is not None
+            else set()
+        )
+        if out_cap is not None and cap_key not in synced:
+            # warm path: reuse an EARLIER RUN's capacity, validate on device
             # (rides the task-boundary fetch); a grown join output triggers
-            # invalidate-and-retry, which re-syncs and re-caches
+            # invalidate-and-retry, which re-syncs and re-caches. Keys this
+            # run itself synced are excluded — an earlier smaller batch's
+            # write must not turn later batches speculative mid-run (the
+            # validation would fire every retry, never converging).
             total_dev = _jit_expand_total(preserve)(probe, count)
             ctx.defer_speculation(
                 total_dev > out_cap,
@@ -558,6 +572,7 @@ class HashJoinExec(ExecutionPlan):
             out_cap = round_capacity(max(total, 1))
             if cache is not None and cap_key:
                 cache[cap_key] = max(out_cap, cache.get(cap_key) or 0)
+                synced.add(cap_key)
 
         key = (tuple(probe_keys), kind, out_cap)
         fn = self._filtered_probe_cache.get(key)
