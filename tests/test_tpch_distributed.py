@@ -7,6 +7,7 @@ serde, stage decomposition, shuffle IO, and result fetch for every TPC-H
 shape (ref: the docker TPC-H integration run, dev/integration-tests.sh).
 """
 
+import pathlib
 import subprocess
 import sys
 
@@ -36,9 +37,9 @@ for name, t in data.items():
 mismatches = []
 for n in range(1, 23):
     sql = (QDIR / f"q{n}.sql").read_text()
-    want = local.sql(sql).collect().to_pandas()
-    got = dist.sql(sql).collect().to_pandas()
     try:
+        want = local.sql(sql).collect().to_pandas()
+        got = dist.sql(sql).collect().to_pandas()
         assert list(got.columns) == list(want.columns), (
             got.columns, want.columns
         )
@@ -57,8 +58,10 @@ for n in range(1, 23):
                     )
                 else:
                     assert list(a) == list(b), c
-    except AssertionError as e:
-        mismatches.append((n, str(e)[:200]))
+    except Exception as e:  # record per-query failures, keep going
+        mismatches.append((n, f"{type(e).__name__}: {str(e)[:200]}"))
+        print(f"q{n}: MISMATCH")
+        continue
     print(f"q{n}: {'ok' if not mismatches or mismatches[-1][0] != n else 'MISMATCH'}"
           f" ({len(want)} rows)")
 
@@ -73,7 +76,7 @@ def test_all_queries_distributed_match_local():
     proc = subprocess.run(
         [sys.executable, "-c", SCRIPT],
         env=env,
-        cwd=str(__import__("pathlib").Path(__file__).resolve().parent.parent),
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
         capture_output=True,
         text=True,
         timeout=1800,
