@@ -60,6 +60,35 @@ def test_gen_convert_benchmark_local(tmp_path):
     assert "Query 6 best time" in out
 
 
+def test_loadtest_local(tmp_path):
+    data = tmp_path / "data"
+    _run("gen", "--scale", "0.002", "--path", str(data))
+    out = _run(
+        "loadtest", "ballista", "-q", "1,6", "-p", str(data),
+        "-r", "4", "-c", "2",
+    )
+    assert "loadtest: 4 requests" in out
+
+
+def test_micro_benchmarks(tmp_path):
+    import json
+    import subprocess as sp
+
+    micro = str(
+        Path(__file__).resolve().parent.parent / "benchmarks" / "micro.py"
+    )
+    proc = sp.run(
+        [sys.executable, micro, "--rows", "20000", "--samples", "2",
+         "-o", str(tmp_path / "micro.json")],
+        env=ENV, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    recs = json.loads((tmp_path / "micro.json").read_text())
+    assert {r["benchmark_name"] for r in recs} >= {
+        "stable_argsort_i64", "group_aggregate_sum_count", "join_probe",
+    }
+
+
 def test_benchmark_ballista_remote(tmp_path):
     data = tmp_path / "data"
     _run("gen", "--scale", "0.002", "--path", str(data))
