@@ -127,6 +127,16 @@ class PhysicalPlanner:
             return ProjectionExec(self._plan(node.input), list(node.exprs))
         if isinstance(node, P.Filter):
             return FilterExec(self._plan(node.input), node.predicate)
+        if isinstance(node, P.Window):
+            from ballista_tpu.exec.window import WindowExec
+
+            # WindowExec gathers all input partitions itself (a ranking
+            # window needs every row of a partition in one place)
+            return WindowExec(
+                self._plan(node.input),
+                list(node.window_exprs),
+                list(node.names),
+            )
         if isinstance(node, P.Aggregate):
             return self._plan_aggregate(node)
         if isinstance(node, P.Distinct):

@@ -1,0 +1,206 @@
+"""Ranking window operator: ROW_NUMBER / RANK / DENSE_RANK.
+
+The DataFusion WindowAggExec role, restricted to ranking functions (no
+frames, no argument-taking windows). TPU-native design: sort by (partition
+keys, order keys) via the cached sort passes, then ONE cached jitted
+finisher per (shape, function) computes the ranks on the sorted rows from
+segment-boundary flags (the same changed/cumsum machinery the sort-based
+aggregate uses) and scatters them back to the ORIGINAL row positions
+through the permutation — the operator appends columns without reordering
+its input. Window expressions sharing identical sort keys share one sort.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.datatypes import DataType, Field, Schema
+from ballista_tpu.errors import PlanError
+from ballista_tpu.exec.base import (
+    ExecutionPlan,
+    TaskContext,
+    UnknownPartitioning,
+)
+from ballista_tpu.expr import logical as L
+from ballista_tpu.ops.concat import concat_batches
+from ballista_tpu.ops.perm import take
+from ballista_tpu.ops.sort import SortKey, sort_perm
+
+
+@functools.lru_cache(maxsize=None)
+def _rank_program(
+    part_nulls: tuple, order_nulls: tuple, fname: str, cap: int
+):
+    """Cached finisher keyed on (null-mask pattern of partition keys,
+    null-mask pattern of order keys, function, capacity). Inputs are the
+    SORTED key columns (+ their null masks where the pattern says so) and
+    the permutation; output is the rank column at ORIGINAL row positions.
+    Gathers/cumsums plus one unique-index permutation scatter."""
+
+    def changed_of(cols, nulls):
+        changed = jnp.zeros(cap, dtype=bool).at[0].set(True)
+        for col, nm in zip(cols, nulls):
+            zc = col if nm is None else jnp.where(nm, jnp.zeros_like(col), col)
+            changed = changed | jnp.concatenate(
+                [jnp.ones(1, dtype=bool), zc[1:] != zc[:-1]]
+            )
+            if nm is not None:
+                changed = changed | jnp.concatenate(
+                    [jnp.ones(1, dtype=bool), nm[1:] != nm[:-1]]
+                )
+        return changed
+
+    def f(part_cols, part_nmasks, order_cols, order_nmasks, perm):
+        idx = jnp.arange(cap, dtype=jnp.int64)
+        part_changed = (
+            changed_of(part_cols, part_nmasks)
+            if part_cols
+            else jnp.zeros(cap, dtype=bool).at[0].set(True)
+        )
+        order_changed = (
+            changed_of(order_cols, order_nmasks)
+            if order_cols
+            else jnp.zeros(cap, dtype=bool)
+        )
+        start = jax.lax.cummax(jnp.where(part_changed, idx, 0))
+        if fname == "row_number":
+            vals = idx - start + 1
+        elif fname == "rank":
+            peer_start = jax.lax.cummax(
+                jnp.where(part_changed | order_changed, idx, 0)
+            )
+            vals = peer_start - start + 1
+        else:  # dense_rank
+            dr = jnp.cumsum((part_changed | order_changed).astype(jnp.int64))
+            dr_at_start = jax.lax.cummax(jnp.where(part_changed, dr, 0))
+            vals = dr - dr_at_start + 1
+        # back to original row order: out[perm[i]] = vals[i] (perm is a
+        # permutation -> unique indices)
+        return (
+            jnp.zeros(cap, dtype=jnp.int64)
+            .at[perm]
+            .set(vals, unique_indices=True)
+        )
+
+    return jax.jit(f)
+
+
+class WindowExec(ExecutionPlan):
+    """Appends one INT64 rank column per window expression. Gathers ALL
+    input partitions (a ranking window needs every row of a partition in
+    one place), so output partitioning is 1."""
+
+    def __init__(self, input: ExecutionPlan, window_exprs, names) -> None:
+        super().__init__()
+        self.input = input
+        self.window_exprs = list(window_exprs)
+        self.names = list(names)
+        ins = input.schema()
+        self._schema = Schema(
+            list(ins.fields)
+            + [Field(n, DataType.INT64, False) for n in self.names]
+        )
+        # resolve key columns now (planner guarantees column refs);
+        # nulls_first defaults to the engine's Sort convention
+        # (FIRST for DESC, LAST for ASC)
+        self._keys: list[tuple[tuple[int, ...], tuple[SortKey, ...]]] = []
+        for w in self.window_exprs:
+            for e in list(w.partition_by) + [e for e, _, _ in w.order_by]:
+                if not isinstance(e, L.Column):
+                    raise PlanError(
+                        "window PARTITION BY / ORDER BY must be columns "
+                        "(project expressions first)"
+                    )
+            self._keys.append(
+                (
+                    tuple(
+                        L.resolve_field_index(ins, e.cname)
+                        for e in w.partition_by
+                    ),
+                    tuple(
+                        SortKey(
+                            col=L.resolve_field_index(ins, e.cname),
+                            ascending=asc,
+                            nulls_first=(
+                                nf if nf is not None else not asc
+                            ),
+                        )
+                        for e, asc, nf in w.order_by
+                    ),
+                )
+            )
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def output_partitioning(self):
+        return UnknownPartitioning(1)
+
+    def describe(self) -> str:
+        return "WindowExec: " + ", ".join(
+            f"{n} = {w.name()}"
+            for n, w in zip(self.names, self.window_exprs)
+        )
+
+    def execute(
+        self, partition: int, ctx: TaskContext
+    ) -> Iterator[DeviceBatch]:
+        batches = []
+        part = self.input.output_partitioning()
+        for p in range(part.n):
+            batches.extend(self.input.execute(p, ctx))
+        if not batches:
+            return
+        b = concat_batches(batches) if len(batches) > 1 else batches[0]
+        out_cols = list(b.columns)
+        out_nulls = list(b.nulls)
+        perm_cache: dict = {}  # shared sort for identical key sets
+        for w, (pk, ok) in zip(self.window_exprs, self._keys):
+            sk = tuple(SortKey(col=i, ascending=True) for i in pk) + ok
+            perm = perm_cache.get(sk)
+            if perm is None:
+                with self.metrics.time("sort_time"):
+                    perm = sort_perm(b, list(sk))
+                perm_cache[sk] = perm
+
+            def gathered(i):
+                return (
+                    take(b.columns[i], perm),
+                    None
+                    if b.nulls[i] is None
+                    else take(b.nulls[i], perm),
+                )
+
+            part_pairs = [gathered(i) for i in pk]
+            order_pairs = [gathered(k.col) for k in ok]
+            prog = _rank_program(
+                tuple(b.nulls[i] is not None for i in pk),
+                tuple(b.nulls[k.col] is not None for k in ok),
+                w.fname,
+                b.capacity,
+            )
+            with self.metrics.time("rank_time"):
+                vals = prog(
+                    [c for c, _ in part_pairs],
+                    [m for _, m in part_pairs],
+                    [c for c, _ in order_pairs],
+                    [m for _, m in order_pairs],
+                    perm,
+                )
+            out_cols.append(vals)
+            out_nulls.append(None)
+        yield DeviceBatch(
+            schema=self._schema,
+            columns=tuple(out_cols),
+            valid=b.valid,
+            nulls=tuple(out_nulls),
+            dictionaries=dict(b.dictionaries),
+        )

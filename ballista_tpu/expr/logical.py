@@ -633,6 +633,74 @@ class Wildcard(Expr):
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
+class WindowFunction(Expr):
+    """Ranking window function: ``fname() OVER (PARTITION BY ... ORDER BY
+    ...)``. Only ranking functions (row_number/rank/dense_rank) — they
+    need no argument and no frame. Evaluated by the Window plan node, not
+    row-expression compilation."""
+
+    fname: str
+    partition_by: tuple[Expr, ...]
+    # (expr, ascending, nulls_first) — nulls_first None = SQL default
+    # (FIRST for DESC, LAST for ASC, matching the engine's Sort)
+    order_by: tuple[tuple[Expr, bool, bool | None], ...]
+
+    def __init__(self, fname, partition_by, order_by):
+        object.__setattr__(self, "fname", fname)
+        object.__setattr__(self, "partition_by", tuple(partition_by))
+        object.__setattr__(
+            self,
+            "order_by",
+            tuple(
+                (t[0], t[1], t[2] if len(t) > 2 else None) for t in order_by
+            ),
+        )
+        if fname not in ("row_number", "rank", "dense_rank"):
+            raise PlanError(f"unsupported window function {fname!r}")
+
+    def data_type(self, schema: Schema) -> DataType:
+        return DataType.INT64
+
+    def nullable(self, schema: Schema) -> bool:
+        return False
+
+    def children(self) -> list[Expr]:
+        return list(self.partition_by) + [e for e, _, _ in self.order_by]
+
+    def with_children(self, children: list[Expr]) -> "WindowFunction":
+        np_ = len(self.partition_by)
+        return WindowFunction(
+            self.fname,
+            tuple(children[:np_]),
+            tuple(
+                (c, asc, nf)
+                for c, (_, asc, nf) in zip(children[np_:], self.order_by)
+            ),
+        )
+
+    def name(self) -> str:
+        parts = []
+        if self.partition_by:
+            parts.append(
+                "PARTITION BY " + ", ".join(e.name() for e in self.partition_by)
+            )
+        if self.order_by:
+            parts.append(
+                "ORDER BY "
+                + ", ".join(
+                    f"{e.name()}{'' if asc else ' DESC'}"
+                    + (
+                        ""
+                        if nf is None
+                        else (" NULLS FIRST" if nf else " NULLS LAST")
+                    )
+                    for e, asc, nf in self.order_by
+                )
+            )
+        return f"{self.fname}() OVER ({' '.join(parts)})"
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
 class AggregateExpr(Expr):
     func: AggFunc
     arg: Expr  # Wildcard for COUNT(*)

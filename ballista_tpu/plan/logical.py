@@ -315,6 +315,37 @@ class Distinct(LogicalPlan):
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
+class Window(LogicalPlan):
+    """Appends one INT64 column per ranking window expression (DataFusion
+    WindowAggExec's role, restricted to ranking functions). ``names`` are
+    the appended output column names (the SQL planner's select list then
+    references them as ordinary columns)."""
+
+    input: LogicalPlan
+    window_exprs: tuple  # of L.WindowFunction
+    names: tuple  # of str, same length
+
+    def schema(self) -> Schema:
+        from ballista_tpu.datatypes import DataType, Field
+
+        return Schema(
+            list(self.input.schema().fields)
+            + [Field(n, DataType.INT64, False) for n in self.names]
+        )
+
+    def children(self) -> list[LogicalPlan]:
+        return [self.input]
+
+    def with_children(self, children: list[LogicalPlan]) -> "Window":
+        return Window(children[0], self.window_exprs, self.names)
+
+    def describe(self) -> str:
+        return "Window: " + ", ".join(
+            f"{n} = {w.name()}" for n, w in zip(self.names, self.window_exprs)
+        )
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
 class SubqueryAlias(LogicalPlan):
     """``FROM (subquery) alias`` / ``FROM table alias`` — requalifies every
     output field as ``alias.base`` so self-joins can disambiguate

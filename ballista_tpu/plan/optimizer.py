@@ -38,6 +38,7 @@ from ballista_tpu.plan.logical import (
     SubqueryAlias,
     TableScan,
     Union,
+    Window,
 )
 
 EPOCH = datetime.date(1970, 1, 1)
@@ -583,6 +584,18 @@ def _prune(plan: LogicalPlan, required: set[str] | None) -> LogicalPlan:
         return plan.with_children(
             [_prune(plan.left, lreq), _prune(plan.right, rreq)]
         )
+    if isinstance(plan, Window):
+        # the input must keep the window's key columns; the window's own
+        # output names are produced here, not required below
+        if required is None:
+            inner_req = None
+        else:
+            inner_req = {r for r in required if r not in plan.names}
+            inner_req |= _expr_columns(
+                [e for w in plan.window_exprs for e in w.partition_by]
+                + [e for w in plan.window_exprs for e, _, _ in w.order_by]
+            )
+        return plan.with_children([_prune(plan.input, inner_req)])
     if isinstance(plan, Union):
         # column pruning across union requires positional mapping; skip.
         return plan.with_children([_prune(c, None) for c in plan.children()])

@@ -352,6 +352,14 @@ def logical_to_proto(plan: P.LogicalPlan) -> pb.LogicalPlanNode:
                 inputs=[logical_to_proto(c) for c in plan.inputs], all=plan.all
             )
         )
+    if isinstance(plan, P.Window):
+        return pb.LogicalPlanNode(
+            window=pb.WindowNode(
+                input=logical_to_proto(plan.input),
+                exprs=[_window_expr_to_proto(w) for w in plan.window_exprs],
+                names=list(plan.names),
+            )
+        )
     if isinstance(plan, P.Distinct):
         return pb.LogicalPlanNode(
             distinct=pb.LogicalUnaryNode(input=logical_to_proto(plan.input))
@@ -370,6 +378,29 @@ def logical_to_proto(plan: P.LogicalPlan) -> pb.LogicalPlanNode:
             )
         )
     raise PlanError(f"cannot serialize logical node {type(plan).__name__}")
+
+
+def _window_expr_to_proto(w) -> pb.WindowExprNode:
+    return pb.WindowExprNode(
+        fname=w.fname,
+        partition_by=[expr_to_proto(e) for e in w.partition_by],
+        order_exprs=[expr_to_proto(e) for e, _, _ in w.order_by],
+        order_asc=[asc for _, asc, _ in w.order_by],
+        order_nulls=[
+            -1 if nf is None else int(nf) for _, _, nf in w.order_by
+        ],
+    )
+
+
+def _window_expr_from_proto(w: pb.WindowExprNode):
+    return L.WindowFunction(
+        w.fname,
+        tuple(expr_from_proto(e) for e in w.partition_by),
+        tuple(
+            (expr_from_proto(e), asc, None if nf < 0 else bool(nf))
+            for e, asc, nf in zip(w.order_exprs, w.order_asc, w.order_nulls)
+        ),
+    )
 
 
 def logical_from_proto(p: pb.LogicalPlanNode) -> P.LogicalPlan:
@@ -436,6 +467,12 @@ def logical_from_proto(p: pb.LogicalPlanNode) -> P.LogicalPlan:
         )
     if kind == "distinct":
         return P.Distinct(logical_from_proto(p.distinct.input))
+    if kind == "window":
+        return P.Window(
+            logical_from_proto(p.window.input),
+            tuple(_window_expr_from_proto(w) for w in p.window.exprs),
+            tuple(p.window.names),
+        )
     if kind == "subquery_alias":
         return P.SubqueryAlias(
             logical_from_proto(p.subquery_alias.input), p.subquery_alias.alias
@@ -574,6 +611,18 @@ class BallistaCodec:
             return pb.PhysicalPlanNode(
                 coalesce_partitions=pb.PhysicalUnaryNode(
                     input=self.physical_to_proto(plan.input)
+                )
+            )
+        from ballista_tpu.exec.window import WindowExec
+
+        if isinstance(plan, WindowExec):
+            return pb.PhysicalPlanNode(
+                window=pb.PhysicalWindowNode(
+                    input=self.physical_to_proto(plan.input),
+                    exprs=[
+                        _window_expr_to_proto(w) for w in plan.window_exprs
+                    ],
+                    names=list(plan.names),
                 )
             )
         if isinstance(plan, EmptyExec):
@@ -758,6 +807,14 @@ class BallistaCodec:
         if kind == "coalesce_partitions":
             return CoalescePartitionsExec(
                 self.physical_from_proto(p.coalesce_partitions.input)
+            )
+        if kind == "window":
+            from ballista_tpu.exec.window import WindowExec
+
+            return WindowExec(
+                self.physical_from_proto(p.window.input),
+                [_window_expr_from_proto(w) for w in p.window.exprs],
+                list(p.window.names),
             )
         if kind == "empty":
             return EmptyExec(

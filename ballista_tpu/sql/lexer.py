@@ -34,7 +34,7 @@ KEYWORDS = {
     "location", "show", "tables", "columns", "asc", "desc", "nulls", "first",
     "last", "true", "false", "explain", "drop", "if", "partitioned",
     "delimiter", "compression", "analyze", "verbose", "for", "year", "month",
-    "day", "describe", "insert", "into", "values",
+    "day", "describe", "insert", "into", "values", "over", "partition",
 }
 
 _TWO_CHAR_OPS = {"<>", "!=", ">=", "<=", "||"}

@@ -107,7 +107,8 @@ class Parser:
             return t.value
         if t.kind == Tok.KEYWORD and t.value in (
             "year", "month", "day", "date", "timestamp", "first", "last",
-            "location", "tables", "columns", "row", "values",
+            "location", "tables", "columns", "row", "values", "over",
+            "partition",
         ):
             return t.value
         raise SqlError(f"expected identifier but found {t.value!r} at offset {t.pos}")
@@ -694,6 +695,29 @@ class Parser:
             while self.accept_punct(","):
                 args.append(self.parse_expr())
             self.expect_punct(")")
+        if name in ("row_number", "rank", "dense_rank") and self.peek().is_kw(
+            "over"
+        ):
+            if args:
+                raise SqlError(f"{name}() takes no arguments")
+            return self.parse_over_clause(name)
         if name == "substring":
             name = "substr"
         return L.ScalarFunction(name, tuple(args))
+
+    def parse_over_clause(self, fname: str) -> L.Expr:
+        """``OVER ( [PARTITION BY e, ...] [ORDER BY items] )``."""
+        self.expect_kw("over")
+        self.expect_punct("(")
+        partition_by: list[L.Expr] = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition_by.append(self.parse_expr())
+            while self.accept_punct(","):
+                partition_by.append(self.parse_expr())
+        order_by = [
+            (item.expr, item.ascending, item.nulls_first)
+            for item in self.parse_order_by()
+        ]
+        self.expect_punct(")")
+        return L.WindowFunction(fname, tuple(partition_by), tuple(order_by))

@@ -37,6 +37,7 @@ from ballista_tpu.plan.logical import (
     Projection,
     Sort,
     SortExpr,
+    Window,
     SubqueryAlias,
     TableScan,
     Union,
@@ -72,6 +73,12 @@ class DictCatalog(Catalog):
         if table not in self.tables:
             raise PlanError(f"table {table!r} not found")
         return self.tables[table]
+
+
+def _walk_exprs(e: L.Expr):
+    yield e
+    for c in e.children():
+        yield from _walk_exprs(c)
 
 
 def _split_conjuncts(e: L.Expr) -> list[L.Expr]:
@@ -179,6 +186,53 @@ class SqlPlanner:
             agg_nodes.extend(L.find_aggregates(having))
         for ob in s.order_by:
             agg_nodes.extend(L.find_aggregates(ob.expr))
+
+        # 3b. window functions: computed over the post-WHERE rows, appended
+        # as synthetic columns the select list then references. Ranking
+        # windows mixed with GROUP BY would need the aggregate output as
+        # window input — not supported yet, reject loudly.
+        window_nodes: list[L.WindowFunction] = []
+        for p in projections:
+            window_nodes.extend(
+                e for e in _walk_exprs(p) if isinstance(e, L.WindowFunction)
+            )
+        if window_nodes:
+            if agg_nodes or group_exprs or any(
+                L.find_aggregates(p) for p in projections
+            ):
+                raise PlanError(
+                    "window functions combined with GROUP BY/aggregates "
+                    "are not supported yet"
+                )
+            uniq: list[L.WindowFunction] = []
+            for w in window_nodes:
+                if not any(w.name() == u.name() for u in uniq):
+                    uniq.append(w)
+            names = tuple(f"__w{i}" for i in range(len(uniq)))
+            plan = Window(plan, tuple(uniq), names)
+            by_name = {w.name(): n for w, n in zip(uniq, names)}
+
+            def _sub_window(e: L.Expr) -> L.Expr:
+                if isinstance(e, L.WindowFunction):
+                    return L.Column(by_name[e.name()])
+                kids = e.children()
+                if kids:
+                    e = e.with_children([_sub_window(c) for c in kids])
+                return e
+
+            # a bare top-level window keeps its display name as the output
+            # column (not the synthetic __wN), matching aggregate naming
+            projections = [
+                L.Alias(L.Column(by_name[p.name()]), p.name())
+                if isinstance(p, L.WindowFunction)
+                else _sub_window(p)
+                for p in projections
+            ]
+            alias_map = {
+                p.aname: p.expr
+                for p in projections
+                if isinstance(p, L.Alias)
+            }
 
         if agg_nodes or group_exprs:
             plan, projections, having = self._plan_aggregate(

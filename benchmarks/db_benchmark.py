@@ -22,9 +22,9 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 GROUPBY_QUERIES = {
-    # ref groupby-datafusion.py:73-226 (q4/q6/q8/q9 need
-    # percentile/stddev/window/corr — not implemented; skipped like the
-    # reference skips engines' unsupported questions)
+    # ref groupby-datafusion.py:73-226 (q6/q9 need percentile/stddev/corr —
+    # not implemented; skipped like the reference skips engines'
+    # unsupported questions)
     "q1": "SELECT id1, SUM(v1) AS v1 FROM x GROUP BY id1",
     "q2": "SELECT id1, id2, SUM(v1) AS v1 FROM x GROUP BY id1, id2",
     "q3": "SELECT id3, SUM(v1) AS v1, AVG(v3) AS v3 FROM x GROUP BY id3",
@@ -33,6 +33,9 @@ GROUPBY_QUERIES = {
     "q5": "SELECT id6, SUM(v1) AS v1, SUM(v2) AS v2, SUM(v3) AS v3 "
           "FROM x GROUP BY id6",
     "q7": "SELECT id3, MAX(v1) - MIN(v2) AS range_v1_v2 FROM x GROUP BY id3",
+    "q8": "SELECT id6, v3 from (SELECT id6, v3, row_number() OVER "
+          "(PARTITION BY id6 ORDER BY v3 DESC) AS row FROM x) t "
+          "WHERE row <= 2",
     "q10": "SELECT id1, id2, id3, id4, id5, id6, SUM(v3) as v3, "
            "COUNT(*) AS cnt FROM x GROUP BY id1, id2, id3, id4, id5, id6",
 }

@@ -1,0 +1,114 @@
+"""Ranking window functions: ROW_NUMBER / RANK / DENSE_RANK with
+PARTITION BY + ORDER BY, locally and through the distributed cluster.
+
+Oracle: pandas groupby ranking. DataFusion provides these via
+WindowAggExec; here the Window plan node sorts by (partition, order) keys
+and computes ranks from segment boundaries (exec/window.py).
+"""
+
+import subprocess
+import sys
+
+from tests.conftest import CPU_MESH_ENV
+
+SCRIPT = r"""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from ballista_tpu.exec.context import TpuContext
+
+r = np.random.default_rng(11)
+n = 3000
+t = pa.table({
+    "g": pa.array(r.integers(0, 20, n).astype(np.int64)),
+    "v": pa.array(np.round(r.uniform(0, 100, n), 6)),
+    "w": pa.array(r.integers(0, 5, n).astype(np.int64)),
+})
+df = t.to_pandas()
+ctx = TpuContext()
+ctx.register_table("t", t)
+
+res = ctx.sql(
+    "select g, v, "
+    "row_number() over (partition by g order by v desc) as rn, "
+    "rank() over (partition by g order by w) as rk, "
+    "dense_rank() over (partition by g order by w) as dr "
+    "from t"
+).collect().to_pandas()
+
+want_rn = (
+    df.sort_values(["g", "v"], ascending=[True, False])
+    .assign(rn=lambda d: d.groupby("g").cumcount() + 1)
+    .rn.values
+)
+merged = res.sort_values(["g", "v"], ascending=[True, False]) \
+    .reset_index(drop=True)
+np.testing.assert_array_equal(merged.rn, want_rn)
+
+# rank/dense_rank vs pandas
+want = df.copy()
+want["rk"] = want.groupby("g").w.rank(method="min").astype(int)
+want["dr"] = want.groupby("g").w.rank(method="dense").astype(int)
+j = res.merge(want, on=["g", "v"], suffixes=("", "_want"))
+np.testing.assert_array_equal(j.rk, j.rk_want)
+np.testing.assert_array_equal(j.dr, j.dr_want)
+
+# window with no PARTITION BY and no ORDER BY edge cases
+res2 = ctx.sql(
+    "select v, row_number() over (order by v) as rn, "
+    "rank() over (partition by g) as rk from t"
+).collect().to_pandas()
+np.testing.assert_array_equal(
+    res2.sort_values("v").rn.values, np.arange(1, n + 1)
+)
+assert (res2.rk == 1).all()  # no ORDER BY -> all rows are peers
+
+# top-k per group through a derived table (h2o db-benchmark q8 shape)
+res3 = ctx.sql(
+    "SELECT g, v from (SELECT g, v, row_number() OVER "
+    "(PARTITION BY g ORDER BY v DESC) AS row FROM t) s WHERE row <= 3"
+).collect().to_pandas()
+want3 = df.sort_values(["g", "v"], ascending=[True, False]).groupby("g").head(3)
+assert len(res3) == len(want3)
+np.testing.assert_allclose(
+    sorted(np.round(res3.v, 6)), sorted(np.round(want3.v, 6))
+)
+
+# unsupported combination fails loudly
+try:
+    ctx.sql("select g, sum(v), row_number() over (order by g) from t group by g").collect()
+    raise SystemExit("expected PlanError")
+except Exception as e:
+    assert "not supported" in str(e), e
+
+# distributed path
+from ballista_tpu.client.context import BallistaContext
+cctx = BallistaContext.standalone()
+cctx.register_table("t", t)
+res4 = cctx.sql(
+    "select g, v, row_number() over (partition by g order by v desc) as rn "
+    "from t"
+).collect().to_pandas()
+j4 = res4.merge(
+    res[["g", "v", "rn"]], on=["g", "v"], suffixes=("", "_local")
+)
+np.testing.assert_array_equal(j4.rn, j4.rn_local)
+cctx.close()
+print("WINDOW-FUNCTIONS-OK")
+"""
+
+
+def test_window_functions():
+    env = {k: v for k, v in CPU_MESH_ENV.items() if k != "XLA_FLAGS"}
+    proc = subprocess.run(
+        [sys.executable, "-c", SCRIPT],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-3000:]}"
+    )
+    assert "WINDOW-FUNCTIONS-OK" in proc.stdout
