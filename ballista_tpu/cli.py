@@ -67,7 +67,10 @@ def list_functions() -> str:
     from ballista_tpu.expr.logical import _SCALAR_FUNCS
     from ballista_tpu.plugin import global_registry
 
-    aggs = ["count", "sum", "min", "max", "avg"]
+    aggs = [
+        "count", "sum", "min", "max", "avg", "stddev", "stddev_pop",
+        "variance", "var_pop", "corr",
+    ]
     udfs = global_registry.names()
     return "\n".join(
         ["-- scalar --"]

@@ -58,8 +58,15 @@ class AggSpec:
     group_names: tuple[str, ...]
     slots: tuple[StateSlot, ...]
     # final output: (output name, dtype, state slot indices, kind)
-    # kind: "id" -> slot value; "avg" -> slots[0]/slots[1]
+    # kind: "id" -> slot value; "avg" -> s/c; "var_samp"/"var_pop"/
+    # "stddev_samp"/"stddev_pop" -> (sum, sumsq, count);
+    # "corr" -> (sx, sy, sxy, sx2, sy2, count)
     finals: tuple[tuple[str, DataType, tuple[int, ...], str], ...]
+    # ordered distinct pre-projection argument expressions (the slots'
+    # src indexes point past the group columns into this list) — the
+    # single source of truth for the pre-projection, so decompositions
+    # can synthesize exprs (x*x, null-masked pairs) no raw arg carries
+    arg_exprs: tuple = ()
 
 
 def decompose_aggregates(
@@ -79,13 +86,23 @@ def decompose_aggregates(
 
     # pre-projection layout: group cols first, then distinct agg args
     arg_index: dict[str, int] = {}
+    arg_exprs: list[L.Expr] = []
     n_groups = len(group_exprs)
 
     def arg_slot(e: L.Expr) -> int:
         key = e.name()
         if key not in arg_index:
-            arg_index[key] = n_groups + len(arg_index)
+            arg_index[key] = n_groups + len(arg_exprs)
+            arg_exprs.append(e)
         return arg_index[key]
+
+    def _masked(e: L.Expr, other: L.Expr) -> L.Expr:
+        """e where BOTH e and other are non-null, else NULL (CORR's
+        pairwise-deletion semantics), via CASE over existing expr nodes."""
+        cond = L.BinaryExpr(
+            L.IsNotNull(e), L.Operator.AND, L.IsNotNull(other)
+        )
+        return L.Case(((cond, e),), None)
 
     for e in agg_exprs:
         aggs = L.find_aggregates(e)
@@ -101,6 +118,39 @@ def decompose_aggregates(
             i1 = slot_for(AggOp.SUM, src, f"{a.name()}#sum")
             i2 = slot_for(AggOp.COUNT, src, f"{a.name()}#count")
             finals.append((a.name(), out_dtype, (i1, i2), "avg"))
+        elif a.func in (
+            L.AggFunc.STDDEV, L.AggFunc.STDDEV_POP,
+            L.AggFunc.VARIANCE, L.AggFunc.VAR_POP,
+        ):
+            x = L.Cast(a.arg, DataType.FLOAT64)
+            src = arg_slot(x)
+            sq = arg_slot(L.BinaryExpr(x, L.Operator.MULTIPLY, x))
+            i1 = slot_for(AggOp.SUM, src, f"{a.name()}#sum")
+            i2 = slot_for(AggOp.SUM, sq, f"{a.name()}#sumsq")
+            i3 = slot_for(AggOp.COUNT, src, f"{a.name()}#count")
+            kind = {
+                L.AggFunc.STDDEV: "stddev_samp",
+                L.AggFunc.STDDEV_POP: "stddev_pop",
+                L.AggFunc.VARIANCE: "var_samp",
+                L.AggFunc.VAR_POP: "var_pop",
+            }[a.func]
+            finals.append((a.name(), out_dtype, (i1, i2, i3), kind))
+        elif a.func == L.AggFunc.CORR:
+            x = L.Cast(_masked(a.arg, a.arg2), DataType.FLOAT64)
+            y = L.Cast(_masked(a.arg2, a.arg), DataType.FLOAT64)
+            sx = arg_slot(x)
+            sy = arg_slot(y)
+            sxy = arg_slot(L.BinaryExpr(x, L.Operator.MULTIPLY, y))
+            sx2 = arg_slot(L.BinaryExpr(x, L.Operator.MULTIPLY, x))
+            sy2 = arg_slot(L.BinaryExpr(y, L.Operator.MULTIPLY, y))
+            i = tuple(
+                slot_for(AggOp.SUM, src, f"{a.name()}#{k}")
+                for k, src in (
+                    ("sx", sx), ("sy", sy), ("sxy", sxy),
+                    ("sx2", sx2), ("sy2", sy2),
+                )
+            ) + (slot_for(AggOp.COUNT, sx, f"{a.name()}#count"),)
+            finals.append((a.name(), out_dtype, i, "corr"))
         elif a.func == L.AggFunc.COUNT:
             src = None if isinstance(a.arg, L.Wildcard) else arg_slot(a.arg)
             i = slot_for(AggOp.COUNT, src, f"{a.name()}#count")
@@ -119,6 +169,7 @@ def decompose_aggregates(
         group_names=tuple(g.name() for g in group_exprs),
         slots=tuple(slots),
         finals=tuple(finals),
+        arg_exprs=tuple(arg_exprs),
     )
 
 
@@ -153,15 +204,43 @@ def _state_batch_program(dtypes: tuple):
     return jax.jit(f, static_argnames=("state_schema",))
 
 
-def _agg_arg_exprs(agg_exprs: list[L.Expr]) -> list[L.Expr]:
-    """Distinct aggregate argument expressions, in first-use order."""
-    seen: dict[str, L.Expr] = {}
-    for e in agg_exprs:
-        for a in L.find_aggregates(e):
-            if isinstance(a.arg, L.Wildcard):
-                continue
-            seen.setdefault(a.arg.name(), a.arg)
-    return list(seen.values())
+def _stat_final(outs_at, idxs, kind):
+    """Shared var/stddev/corr finalization over state slots (``outs_at`` maps
+    a slot index -> its merged value array).
+
+    NUMERICAL DOMAIN NOTE: these use raw-moment formulas (sum, sum-of-
+    squares); they are accurate while mean^2/variance stays well below
+    f64's 2^53 (true for typical measure columns) but suffer catastrophic
+    cancellation for huge-mean/tiny-variance data (e.g. raw unix
+    timestamps) — variance can collapse toward 0 there. The fix is a
+    (count, mean, M2) state with Chan's parallel merge (what DataFusion's
+    Welford-based kernels do); that needs joint-slot merge support in the
+    state machinery and is tracked for the next round. CORR is clamped to
+    [-1, 1] so conditioning errors stay bounded.
+    """
+    if kind in ("var_samp", "var_pop", "stddev_samp", "stddev_pop"):
+        s = outs_at(idxs[0]).astype(jnp.float64)
+        s2 = outs_at(idxs[1]).astype(jnp.float64)
+        c = outs_at(idxs[2]).astype(jnp.float64)
+        pop = kind.endswith("_pop")
+        denom = jnp.maximum(c if pop else c - 1, 1.0)
+        var = jnp.maximum((s2 - s * s / jnp.maximum(c, 1.0)) / denom, 0.0)
+        vals = jnp.sqrt(var) if kind.startswith("stddev") else var
+        nl = (c == 0) if pop else (c < 2)
+        return vals, nl
+    assert kind == "corr"
+    sx = outs_at(idxs[0]).astype(jnp.float64)
+    sy = outs_at(idxs[1]).astype(jnp.float64)
+    sxy = outs_at(idxs[2]).astype(jnp.float64)
+    sx2 = outs_at(idxs[3]).astype(jnp.float64)
+    sy2 = outs_at(idxs[4]).astype(jnp.float64)
+    c = outs_at(idxs[5]).astype(jnp.float64)
+    cn = jnp.maximum(c, 1.0)
+    cov = sxy - sx * sy / cn
+    dd = (sx2 - sx * sx / cn) * (sy2 - sy * sy / cn)
+    vals = jnp.clip(cov / jnp.sqrt(jnp.maximum(dd, 1e-300)), -1.0, 1.0)
+    nl = (c == 0) | (dd <= 0)
+    return vals, nl
 
 
 def finalize_state(
@@ -190,6 +269,12 @@ def finalize_state(
             base_null = state.nulls[n_groups + idxs[0]]
             if base_null is not None:
                 nl = nl | base_null
+        elif kind in (
+            "var_samp", "var_pop", "stddev_samp", "stddev_pop", "corr"
+        ):
+            vals, nl = _stat_final(
+                lambda i: state.columns[n_groups + i], idxs, kind
+            )
         else:
             vals = state.columns[n_groups + idxs[0]]
             nl = state.nulls[n_groups + idxs[0]]
@@ -251,7 +336,7 @@ class HashAggregateExec(ExecutionPlan):
                 else decompose_aggregates(group_exprs, agg_exprs, ins)
             )
             # partial input pre-projection: groups then args
-            self._pre_exprs = list(group_exprs) + _agg_arg_exprs(agg_exprs)
+            self._pre_exprs = list(group_exprs) + list(self.spec.arg_exprs)
             pre_schema_fields = [
                 Field(e.name(), e.data_type(ins), e.nullable(ins))
                 for e in self._pre_exprs
@@ -552,6 +637,10 @@ class HashAggregateExec(ExecutionPlan):
                 s, c = outs[idxs[0]], outs[idxs[1]]
                 v = s.astype(jnp.float64) / jnp.maximum(c, 1).astype(jnp.float64)
                 nl = c == 0
+            elif kind in (
+                "var_samp", "var_pop", "stddev_samp", "stddev_pop", "corr"
+            ):
+                v, nl = _stat_final(lambda i: outs[i], idxs, kind)
             else:
                 v = outs[idxs[0]]
                 nl = nulls[idxs[0]]

@@ -29,7 +29,6 @@ from ballista_tpu.datatypes import DataType, Field, Schema
 from ballista_tpu.errors import PlanError
 from ballista_tpu.exec.aggregate import (
     AggSpec,
-    _agg_arg_exprs,
     decompose_aggregates,
     finalize_state,
 )
@@ -102,7 +101,7 @@ class MeshAggregateExec(ExecutionPlan):
             if spec is not None
             else decompose_aggregates(group_exprs, agg_exprs, ins)
         )
-        self._pre_exprs = list(group_exprs) + _agg_arg_exprs(agg_exprs)
+        self._pre_exprs = list(group_exprs) + list(self.spec.arg_exprs)
         self._pre_schema = Schema(
             [
                 Field(e.name(), e.data_type(ins), e.nullable(ins))

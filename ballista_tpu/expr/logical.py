@@ -104,6 +104,11 @@ class AggFunc(Enum):
     MIN = "min"
     MAX = "max"
     AVG = "avg"
+    STDDEV = "stddev"          # sample (DataFusion's stddev)
+    STDDEV_POP = "stddev_pop"
+    VARIANCE = "variance"      # sample
+    VAR_POP = "var_pop"
+    CORR = "corr"              # two-argument (arg, arg2)
 
 
 class Expr:
@@ -705,12 +710,16 @@ class AggregateExpr(Expr):
     func: AggFunc
     arg: Expr  # Wildcard for COUNT(*)
     distinct: bool = False
+    arg2: Expr | None = None  # CORR's second argument
 
     def data_type(self, schema: Schema) -> DataType:
         if self.func == AggFunc.COUNT:
             return DataType.INT64
         at = self.arg.data_type(schema)
-        if self.func == AggFunc.AVG:
+        if self.func in (
+            AggFunc.AVG, AggFunc.STDDEV, AggFunc.STDDEV_POP,
+            AggFunc.VARIANCE, AggFunc.VAR_POP, AggFunc.CORR,
+        ):
             return DataType.FLOAT64
         if self.func == AggFunc.SUM:
             # SUM widens to the largest type of its class (DataFusion's rule).
@@ -726,13 +735,21 @@ class AggregateExpr(Expr):
 
     def name(self) -> str:
         d = "DISTINCT " if self.distinct else ""
+        if self.arg2 is not None:
+            return (
+                f"{self.func.value.upper()}"
+                f"({d}{self.arg.name()}, {self.arg2.name()})"
+            )
         return f"{self.func.value.upper()}({d}{self.arg.name()})"
 
     def children(self) -> list[Expr]:
-        return [self.arg]
+        return [self.arg] + ([self.arg2] if self.arg2 is not None else [])
 
     def with_children(self, children: list[Expr]) -> "AggregateExpr":
-        return AggregateExpr(self.func, children[0], self.distinct)
+        return AggregateExpr(
+            self.func, children[0], self.distinct,
+            children[1] if len(children) > 1 else None,
+        )
 
     def __repr__(self) -> str:
         return self.name()

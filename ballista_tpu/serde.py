@@ -156,6 +156,11 @@ def expr_to_proto(e: L.Expr) -> pb.ExprNode:
                 func=getattr(pb, f"AGG_{e.func.name}"),
                 arg=expr_to_proto(e.arg),
                 distinct=e.distinct,
+                **(
+                    {"arg2": expr_to_proto(e.arg2)}
+                    if e.arg2 is not None
+                    else {}
+                ),
             )
         )
     if isinstance(e, L.ScalarFunction):
@@ -237,6 +242,9 @@ def expr_from_proto(p: pb.ExprNode) -> L.Expr:
             L.AggFunc[pb.AggFuncP.Name(p.aggregate.func)[4:]],
             expr_from_proto(p.aggregate.arg),
             p.aggregate.distinct,
+            expr_from_proto(p.aggregate.arg2)
+            if p.aggregate.HasField("arg2")
+            else None,
         )
     if kind == "scalar_fn":
         return L.ScalarFunction(

@@ -680,6 +680,10 @@ class Parser:
     def parse_function_call(self) -> L.Expr:
         name = self.next().value.lower()
         self.expect_punct("(")
+        # DataFusion-compatible aliases
+        name = {"stddev_samp": "stddev", "var_samp": "variance"}.get(
+            name, name
+        )
         if name in _AGG_NAMES:
             distinct = self.accept_kw("distinct")
             if self.peek().kind == Tok.OP and self.peek().value == "*":
@@ -687,8 +691,15 @@ class Parser:
                 arg: L.Expr = L.Wildcard()
             else:
                 arg = self.parse_expr()
+            arg2 = None
+            if self.accept_punct(","):
+                if name != "corr":
+                    raise SqlError(f"{name}() takes one argument")
+                arg2 = self.parse_expr()
+            if name == "corr" and arg2 is None:
+                raise SqlError("corr() takes two arguments")
             self.expect_punct(")")
-            return L.AggregateExpr(L.AggFunc(name), arg, distinct)
+            return L.AggregateExpr(L.AggFunc(name), arg, distinct, arg2)
         args: list[L.Expr] = []
         if not self.accept_punct(")"):
             args.append(self.parse_expr())
