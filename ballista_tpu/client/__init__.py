@@ -2,4 +2,23 @@
 
 ref ballista/rust/client (BallistaContext) and core/src/client.rs
 (BallistaClient Flight wrapper).
+
+Re-exports are lazy (module ``__getattr__``): the executor's data plane
+imports ``ballista_tpu.client.flight`` for shuffle fetches and must not
+drag the whole client-context stack (grpc, SQL parser/planner, scheduler
+RPC stubs) into its hot path.
 """
+
+__all__ = ["BallistaContext", "fetch_partition"]
+
+
+def __getattr__(name: str):
+    if name == "BallistaContext":
+        from ballista_tpu.client.context import BallistaContext
+
+        return BallistaContext
+    if name == "fetch_partition":
+        from ballista_tpu.client.flight import fetch_partition
+
+        return fetch_partition
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

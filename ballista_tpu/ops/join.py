@@ -38,6 +38,8 @@ from ballista_tpu.columnar.batch import DeviceBatch
 from ballista_tpu.datatypes import Schema
 from ballista_tpu.errors import ExecutionError
 from ballista_tpu.ops.hashing import hash_columns
+from ballista_tpu.ops.perm import take_many_split
+from ballista_tpu.ops.search import searchsorted
 
 # Max packed-key collision run the probe window resolves. Distinct keys
 # colliding in the 64-bit packed hash is already rare (floats narrow to f32
@@ -330,7 +332,7 @@ def probe_side(
     _check_join_dictionaries(build, probe, probe_key_idxs)
     probe_keys = [probe.columns[i] for i in probe_key_idxs]
     packed = _pack_key(probe_keys, build.mode)
-    idx = jnp.searchsorted(build.keys, packed)
+    idx = searchsorted(build.keys, packed)
     cap_b = build.keys.shape[0]
 
     live = probe.valid
@@ -360,16 +362,20 @@ def probe_side(
     if join_type == JoinSide.ANTI:
         return probe.with_valid(probe.valid & ~match)
 
-    # INNER / LEFT: probe columns ++ build columns gathered at the candidate.
+    # INNER / LEFT: probe columns ++ build columns gathered at the
+    # candidate — one stacked random-access pass per dtype, not one gather
+    # per column (ops/perm.take_many).
     b = build.batch
-    gath_cols = [col[cand] for col in b.columns]
+    gath_cols, gath_m = take_many_split(
+        list(b.columns), list(b.nulls), cand
+    )
     gath_nulls: list[jnp.ndarray | None] = []
-    for m in b.nulls:
+    for m in gath_m:
         if join_type == JoinSide.LEFT:
             # Missed probes: build side is NULL.
-            gm = ~match if m is None else (m[cand] | ~match)
+            gm = ~match if m is None else (m | ~match)
         else:
-            gm = None if m is None else m[cand]
+            gm = m
         gath_nulls.append(gm)
 
     out_cols = tuple(probe.columns) + tuple(gath_cols)
@@ -417,8 +423,8 @@ def probe_counts(
     cap_b = build.keys.shape[0]
 
     if build.mode != "hash":
-        lo = jnp.searchsorted(build.keys, packed, side="left")
-        hi = jnp.searchsorted(build.keys, packed, side="right")
+        lo = searchsorted(build.keys, packed, side="left")
+        hi = searchsorted(build.keys, packed, side="right")
         # Dead tail keys are INT64_MAX; clamping to n keeps a probe key of
         # INT64_MAX from matching dead slots.
         lo = jnp.minimum(lo, build.n).astype(jnp.int32)
@@ -426,7 +432,7 @@ def probe_counts(
         count = jnp.where(live, hi - lo, 0).astype(jnp.int32)
         return lo, count, live
 
-    idx = jnp.searchsorted(build.keys, packed)
+    idx = searchsorted(build.keys, packed)
     first = jnp.zeros(probe.capacity, jnp.int32)
     found = jnp.zeros(probe.capacity, dtype=bool)
     count = jnp.zeros(probe.capacity, jnp.int32)
@@ -464,7 +470,7 @@ def expand_join(
     inc = jnp.cumsum(eff.astype(jnp.int32))
     total = inc[-1]
     j = jnp.arange(out_cap, dtype=jnp.int32)
-    i = jnp.searchsorted(inc, j, side="right").astype(jnp.int32)
+    i = searchsorted(inc, j, side="right").astype(jnp.int32)
     i = jnp.clip(i, 0, cap_p - 1)
     start = inc[i] - eff[i]
     k = j - start
@@ -473,17 +479,18 @@ def expand_join(
     bidx = jnp.clip(first[i] + k, 0, cap_b - 1)
 
     b = build.batch
-    out_cols = tuple(c[i] for c in probe.columns) + tuple(
-        c[bidx] for c in b.columns
+    # probe-side and build-side gathers each stacked by dtype
+    p_cols, p_nulls = take_many_split(
+        list(probe.columns), list(probe.nulls), i
     )
-    out_nulls: list[jnp.ndarray | None] = [
-        None if m is None else m[i] for m in probe.nulls
-    ]
-    for m in b.nulls:
+    b_cols, b_m = take_many_split(list(b.columns), list(b.nulls), bidx)
+    out_cols = tuple(p_cols) + tuple(b_cols)
+    out_nulls: list[jnp.ndarray | None] = list(p_nulls)
+    for m in b_m:
         if join_type == JoinSide.LEFT:
-            gm = ~real if m is None else (m[bidx] | ~real)
+            gm = ~real if m is None else (m | ~real)
         else:
-            gm = None if m is None else m[bidx]
+            gm = m
         out_nulls.append(gm)
 
     schema = probe.schema.join(b.schema)

@@ -55,6 +55,76 @@ def take(col: jnp.ndarray, perm: jnp.ndarray) -> jnp.ndarray:
     return _take_program(str(col.dtype), col.shape[0])(col, perm)
 
 
+def group_by_dtype(cols: list) -> dict:
+    """Positions of ``cols`` grouped by dtype string — the shared index
+    plan for stacked gathers (take_many) and stacked scatters
+    (ops/aggregate)."""
+    by_dtype: dict[str, list[int]] = {}
+    for i, c in enumerate(cols):
+        by_dtype.setdefault(str(c.dtype), []).append(i)
+    return by_dtype
+
+
+def take_many(cols: list, perm: jnp.ndarray) -> list:
+    """Gather many columns by one permutation with one gather per distinct
+    dtype (columns stacked on a trailing axis).
+
+    A TPU gather's cost is dominated by the per-row random access, not the
+    row payload, so gathering an (n, M) stack moves M columns for ~the
+    price of one. Callers inside jit get the stack/unbind fused away."""
+    by_dtype = group_by_dtype(cols)
+    out: list = [None] * len(cols)
+    for dt, idxs in by_dtype.items():
+        if len(idxs) == 1:
+            i = idxs[0]
+            out[i] = cols[i][perm]
+            continue
+        stacked = jnp.stack([cols[i] for i in idxs], axis=1)
+        g = stacked[perm]
+        for j, i in enumerate(idxs):
+            out[i] = g[:, j]
+    return out
+
+
+def take_many_split(
+    cols: list, optionals: list, perm: jnp.ndarray
+) -> tuple[list, list]:
+    """One stacked-by-dtype gather over ``cols`` plus the non-None entries
+    of ``optionals`` (null masks). Returns (gathered cols, gathered
+    optionals with None preserved in place)."""
+    present = [i for i, m in enumerate(optionals) if m is not None]
+    gathered = take_many(
+        list(cols) + [optionals[i] for i in present], perm
+    )
+    out_opt: list = [None] * len(optionals)
+    for j, i in enumerate(present):
+        out_opt[i] = gathered[len(cols) + j]
+    return gathered[: len(cols)], out_opt
+
+
+@functools.lru_cache(maxsize=None)
+def _take_batch_program(sig: tuple, nulls_sig: tuple, cap: int):
+    """One jitted program gathering a whole column set (+ null masks +
+    valid) by a permutation, stacked by dtype — the sort/shuffle data
+    movement as ONE dispatch instead of one per column."""
+
+    def f(cols, nulls, valid, perm):
+        gathered, out_nulls = take_many_split(
+            [valid] + list(cols), list(nulls), perm
+        )
+        return gathered[1:], out_nulls, gathered[0]
+
+    return jax.jit(f)
+
+
+def take_batch(cols: list, nulls: list, valid, perm):
+    """Gather columns + null masks + valid by ``perm`` in one dispatch."""
+    sig = tuple(str(c.dtype) for c in cols)
+    nulls_sig = tuple(m is not None for m in nulls)
+    prog = _take_batch_program(sig, nulls_sig, valid.shape[0])
+    return prog(tuple(cols), tuple(nulls), valid, perm)
+
+
 def refine_perm(
     perm: jnp.ndarray, col: jnp.ndarray, descending: bool = False
 ) -> jnp.ndarray:

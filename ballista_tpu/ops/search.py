@@ -1,0 +1,44 @@
+"""Adaptive vectorized searchsorted for TPU.
+
+``jnp.searchsorted``'s default ``method='scan'`` lowers to ``log2(n)``
+*serial* binary-search passes, each a full gather over the query vector —
+measured ~0.95s for 6M int64 probes into a 1.5M-key table on a v5e chip.
+``method='sort'`` (concatenate + one ``lax.sort`` + scatter of positions)
+is ~4-5x faster at that scale (~0.23s) because the TPU sorts large arrays
+at near-memory bandwidth. For small query vectors the scan's few passes
+are cheap and skip the sort setup, so the method is chosen by query size.
+
+All ``jnp.searchsorted`` methods return identical results, so this is a
+pure scheduling decision. Join probes (ops/join.py) and the expansion-join
+row assignment route through here — they are the hot searchsorted users
+(ref's equivalent hot path is the hash-table probe inside DataFusion's
+HashJoinExec, which Ballista serializes at serde/physical_plan/mod.rs:438).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Below this many probe elements the serial-pass scan wins (sort setup
+# costs more than log2(n) passes over a small vector).
+_SORT_METHOD_MIN_QUERY = 1 << 16
+
+
+def searchsorted(
+    a: jnp.ndarray, v: jnp.ndarray, side: str = "left"
+) -> jnp.ndarray:
+    """Drop-in ``jnp.searchsorted`` with a TPU-tuned method choice.
+
+    The sort-based method is an accelerator tradeoff; on the CPU backend
+    the serial scan wins at every size (measured: the sort method slows
+    TPC-H joins 1.3-3.5x on jax-cpu), so 'sort' is gated on the backend.
+    """
+    import jax
+
+    method = (
+        "sort"
+        if v.size >= _SORT_METHOD_MIN_QUERY
+        and jax.default_backend() != "cpu"
+        else "scan"
+    )
+    return jnp.searchsorted(a, v, side=side, method=method)

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from ballista_tpu.ops.partition import partition_ids_for
 from ballista_tpu.ops.perm import multi_key_perm
+from ballista_tpu.ops.search import searchsorted as _ss
 
 
 def bucket_rows(
@@ -34,7 +35,7 @@ def bucket_rows(
     pid = partition_ids_for(key_cols, key_nulls, valid, n_parts)
     perm = multi_key_perm([(pid, False)])
     pid_s = pid[perm]
-    starts = jnp.searchsorted(pid_s, jnp.arange(n_parts, dtype=pid_s.dtype))
+    starts = _ss(pid_s, jnp.arange(n_parts, dtype=pid_s.dtype))
     cap = valid.shape[0]
     iota = jnp.arange(cap, dtype=jnp.int32)
     pid_c = jnp.clip(pid_s, 0, n_parts - 1)
