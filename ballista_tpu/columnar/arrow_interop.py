@@ -72,8 +72,16 @@ def schema_to_arrow(s: Schema) -> pa.Schema:
     )
 
 
+def fits_int32(mn, mx) -> bool:
+    """The shared int32-narrowing range predicate (deliberately strict:
+    INT32_MIN is excluded so identity sentinels stay representable)."""
+    return mn is not None and -(2**31) < mn and mx < 2**31
+
+
 def _column_to_np(
-    col: pa.ChunkedArray | pa.Array, dtype: DataType
+    col: pa.ChunkedArray | pa.Array,
+    dtype: DataType,
+    narrow: bool | None = None,
 ) -> tuple[np.ndarray, np.ndarray | None, Dictionary | None]:
     """One Arrow column -> (device-repr np array, null mask or None, dict or None)."""
     if isinstance(col, pa.ChunkedArray):
@@ -123,7 +131,28 @@ def _column_to_np(
             raise SchemaError(
                 f"cannot represent column of type {col.type} as {dtype}: {e}"
             ) from e
-    return arr.astype(dtype.to_np(), copy=False), null_mask, None
+    arr = arr.astype(dtype.to_np(), copy=False)
+    if narrow is not False and dtype == DataType.INT64 and arr.size:
+        # Physical narrowing: INT64 identifiers whose values fit int32
+        # (all TPC-H keys up to ~SF300) sort/gather/scatter at half the
+        # bytes and skip the TPU x64 u32-pair emulation. The logical type
+        # stays INT64: arithmetic widens to the logical dtype before the
+        # op (expr/physical._compile_binary), join packing widens to the
+        # packed int64 key, and host exits cast back by schema
+        # (batch_to_arrow / IPC writes). The range recheck guards a caller
+        # whose table-level decision (e.g. parquet statistics) understated
+        # the data; that must fail LOUDLY — a silent per-chunk fallback
+        # would flip physical layouts between partitions.
+        mn, mx = arr.min(), arr.max()
+        if fits_int32(mn, mx):
+            arr = arr.astype(np.int32)
+        elif narrow is True:
+            raise SchemaError(
+                "column marked int32-narrowable contains values outside "
+                f"int32 range [{mn}, {mx}] — table-level statistics "
+                "disagree with the data"
+            )
+    return arr, null_mask, None
 
 
 def batch_from_arrow(rb: pa.RecordBatch | pa.Table, capacity: int | None = None) -> DeviceBatch:
@@ -142,14 +171,47 @@ def batch_from_arrow(rb: pa.RecordBatch | pa.Table, capacity: int | None = None)
     )
 
 
-def table_from_arrow(table: pa.Table, batch_rows: int) -> list[DeviceBatch]:
+def narrowable_int64_cols(table: pa.Table) -> frozenset:
+    """Names of INT64 columns of ``table`` whose full value range fits
+    int32 — computed once per table so every batch/partition cut from it
+    makes the SAME physical-narrowing decision (a per-slice decision would
+    flip layouts between batches and double XLA compiles downstream)."""
+    import pyarrow.compute as pc
+
+    out = set()
+    for field in table.schema:
+        if not pa.types.is_integer(field.type) or field.type.bit_width <= 32:
+            continue
+        if table.num_rows == 0:
+            continue
+        mm = pc.min_max(table.column(field.name))
+        if fits_int32(mm["min"].as_py(), mm["max"].as_py()):
+            out.add(field.name)
+    return frozenset(out)
+
+
+def table_from_arrow(
+    table: pa.Table,
+    batch_rows: int,
+    narrow_cols: frozenset | None = None,
+) -> list[DeviceBatch]:
     """Slice an Arrow table into DeviceBatches of ≤batch_rows rows each,
-    sharing one dictionary per STRING column (encoded table-wide first)."""
+    sharing one dictionary per STRING column (encoded table-wide first).
+
+    ``narrow_cols``: names of INT64 columns to store as physical int32
+    (see narrowable_int64_cols). None = decide from THIS table; callers
+    that convert slices of a larger whole must pass the whole-table set so
+    layouts stay stable across slices. Empty frozenset disables narrowing
+    (the shuffle-read path, where different files must share layouts)."""
     schema = schema_from_arrow(table.schema)
+    if narrow_cols is None:
+        narrow_cols = narrowable_int64_cols(table)
     # Encode strings table-wide so all slices share dictionaries.
     cols_np, nulls_np, dicts = [], [], {}
     for field, name in zip(schema, table.schema.names):
-        arr, nm, d = _column_to_np(table.column(name), field.dtype)
+        arr, nm, d = _column_to_np(
+            table.column(name), field.dtype, narrow=name in narrow_cols
+        )
         cols_np.append(arr)
         nulls_np.append(nm)
         if d is not None:

@@ -105,9 +105,13 @@ class DeviceBatch:
         for field, arr in zip(schema, arrays):
             want = field.dtype.to_np()
             a = np.asarray(arr)
-            if a.dtype != want:
+            if a.dtype != want and not (
+                want == np.int64 and a.dtype == np.int32
+            ):
+                # int32 is a permitted physical form of a logical INT64
+                # column (see arrow_interop narrowing)
                 a = a.astype(want)
-            padded = np.zeros(cap, dtype=want)
+            padded = np.zeros(cap, dtype=a.dtype)
             padded[:n] = a[:n]
             cols.append(jnp.asarray(padded))
         valid = np.zeros(cap, dtype=bool)

@@ -35,6 +35,7 @@ BALLISTA_PLUGIN_DIR = "ballista.plugin_dir"
 # TPU-native extensions.
 BALLISTA_DEVICE = "ballista.tpu.device"  # "tpu" | "cpu" | "auto"
 BALLISTA_AGG_CAPACITY = "ballista.tpu.agg_capacity"  # max distinct groups per kernel
+BALLISTA_TPU_BATCH_ROWS = "ballista.tpu.batch_rows"  # device-batch row budget
 BALLISTA_PROFILE_DIR = "ballista.tpu.profile_dir"  # XLA profiler trace output
 BALLISTA_JOIN_EXPANSION = "ballista.tpu.join_expansion"  # probe-output expansion factor
 BALLISTA_COLLECTIVE_SHUFFLE = "ballista.tpu.collective_shuffle"  # on-pod all_to_all
@@ -132,6 +133,14 @@ def _entries() -> dict[str, ConfigEntry]:
             int,
         ),
         ConfigEntry(
+            BALLISTA_TPU_BATCH_ROWS,
+            "Rows per DeviceBatch cut from a scan (the device-side analogue "
+            "of ballista.batch.size; larger batches amortize per-dispatch "
+            "and per-batch aggregate costs, smaller ones bound HBM use)",
+            str(1 << 20),
+            int,
+        ),
+        ConfigEntry(
             BALLISTA_JOIN_EXPANSION,
             "Max probe-output rows per input row for non-unique joins",
             "4",
@@ -221,6 +230,9 @@ class BallistaConfig:
 
     def device(self) -> str:
         return self._get(BALLISTA_DEVICE)
+
+    def tpu_batch_rows(self) -> int:
+        return self._get(BALLISTA_TPU_BATCH_ROWS)
 
     def agg_capacity(self) -> int:
         return self._get(BALLISTA_AGG_CAPACITY)

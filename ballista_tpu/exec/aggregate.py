@@ -187,10 +187,19 @@ def _state_batch_program(dtypes: tuple):
     cheap jitted cast/pack program per layout)."""
 
     def f(res, state_schema):
+        import numpy as np
+
         cols = list(res.keys) + list(res.values)
         nulls = list(res.key_nulls) + list(res.value_nulls)
+        # int32 is a permitted physical form of a logical INT64 column
+        # (arrow_interop narrowing) — keep it narrow through agg states so
+        # the final merge's sort passes stay 32-bit; mixed-width states
+        # promote automatically at concat.
         cols = [
-            c.astype(f_.dtype.to_np()) if c.dtype != f_.dtype.to_np() else c
+            c
+            if c.dtype == f_.dtype.to_np()
+            or (f_.dtype.to_np() == np.int64 and c.dtype == np.int32)
+            else c.astype(f_.dtype.to_np())
             for c, f_ in zip(cols, state_schema)
         ]
         return DeviceBatch(
@@ -618,7 +627,19 @@ class HashAggregateExec(ExecutionPlan):
             )
             yield self._finalize_scalar(outs, nulls)
             return
-        merged = concat_batches(states) if len(states) > 1 else states[0]
+        if len(states) == 1:
+            # A single state batch comes from ONE partial output (partials
+            # emit one folded state per partition; the in-proc repartition
+            # masks rather than concatenates), so its group keys are
+            # already unique — the merge aggregation would re-sort the full
+            # state capacity to rediscover the same groups. Skip it.
+            # (Timed under merge_time so per-query metric reports stay
+            # comparable with the merging shape.)
+            with self.metrics.time("merge_time"):
+                out = self._finalize(states[0], n_groups)
+            yield out
+            return
+        merged = concat_batches(states)
         with self.metrics.time("merge_time"):
             state = self._run_group_agg(
                 merged, merge_ops, n_groups, cap, from_state=True, ctx=ctx

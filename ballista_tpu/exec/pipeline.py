@@ -99,11 +99,19 @@ class ProjectionExec(ExecutionPlan):
 
             def run(batch: DeviceBatch) -> DeviceBatch:
                 cols, nulls, dicts = [], [], {}
+                import numpy as np
+
                 for field, p in zip(out_schema, phys):
                     cv = p.evaluate(batch)
                     vals = cv.values
                     want = field.dtype.to_np()
-                    if vals.dtype != want:
+                    if vals.dtype != want and not (
+                        want == np.int64 and vals.dtype == np.int32
+                    ):
+                        # int32 is a permitted physical form of a logical
+                        # INT64 column (arrow_interop narrowing) — widening
+                        # it here would undo the narrowing right before the
+                        # sorts/gathers it exists for
                         vals = vals.astype(want)
                     cols.append(vals)
                     nulls.append(cv.nulls)

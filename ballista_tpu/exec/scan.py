@@ -64,6 +64,9 @@ class MemoryScanExec(ExecutionPlan):
         self.partitions = max(1, partitions)
         self.batch_rows = batch_rows
         self.device_cache = device_cache
+        # INT64 columns to store as physical int32 (None = decide from the
+        # table on first execute; see arrow_interop.narrowable_int64_cols)
+        self.narrow_cols: frozenset | None = None
 
     def schema(self) -> Schema:
         return self._schema
@@ -98,7 +101,17 @@ class MemoryScanExec(ExecutionPlan):
             out = [DeviceBatch.empty(self._schema)]
         else:
             chunk = t.slice(start, stop - start)
-            out = list(table_from_arrow(chunk, self.batch_rows))
+            # narrowing decided over the WHOLE table so every partition
+            # slice shares one physical layout (stable compile shapes)
+            if self.narrow_cols is None:
+                from ballista_tpu.columnar.arrow_interop import (
+                    narrowable_int64_cols,
+                )
+
+                self.narrow_cols = narrowable_int64_cols(t)
+            out = list(
+                table_from_arrow(chunk, self.batch_rows, self.narrow_cols)
+            )
         if self.device_cache is not None:
             self.device_cache[key] = out
         for b in out:
@@ -133,6 +146,7 @@ class CsvScanExec(ExecutionPlan):
         self.partitions = max(1, partitions)
         self.batch_rows = batch_rows
         self._table: pa.Table | None = None
+        self._narrow_cols: frozenset | None = None
 
     def schema(self) -> Schema:
         return self._schema
@@ -165,10 +179,19 @@ class CsvScanExec(ExecutionPlan):
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
         with self.metrics.time("read_time"):
             t = self._read()
+        if self._narrow_cols is None:
+            # computed ONCE per operator (not per partition) over the full
+            # parsed table, like _read caches the parse itself
+            from ballista_tpu.columnar.arrow_interop import (
+                narrowable_int64_cols,
+            )
+
+            self._narrow_cols = narrowable_int64_cols(t)
         mem = MemoryScanExec(
             t, self.table_schema, self.projection, self.partitions,
             self.batch_rows,
         )
+        mem.narrow_cols = self._narrow_cols
         yield from mem.execute(partition, ctx)
 
 
@@ -379,4 +402,41 @@ class ParquetScanExec(ExecutionPlan):
         # column order must match the projected schema
         t = t.select([fld.name for fld in self._schema])
         mem = MemoryScanExec(t, self._schema, None, 1, self.batch_rows)
+        # narrow by FILE-level statistics (all row groups), not this
+        # partition's subset — partitions must share one physical layout
+        mem.narrow_cols = self._narrowable_from_stats(f)
         yield from mem.execute(0, ctx)
+
+    def _narrowable_from_stats(self, f: "papq.ParquetFile") -> frozenset:
+        """INT64 columns whose min/max over EVERY row group (from parquet
+        column statistics) fit int32; columns lacking statistics are left
+        wide — a data-derived per-partition decision would flip layouts."""
+        md = f.metadata
+        name_to_dtype = {fl.name: fl.dtype for fl in self._schema}
+        lo: dict[str, int] = {}
+        hi: dict[str, int] = {}
+        skip: set[str] = set()
+        for g in range(md.num_row_groups):
+            rg = md.row_group(g)
+            for ci in range(rg.num_columns):
+                col = rg.column(ci)
+                name = col.path_in_schema
+                if name_to_dtype.get(name) != DataType.INT64:
+                    continue
+                st = col.statistics
+                if (
+                    st is None
+                    or not st.has_min_max
+                    or not isinstance(st.min, int)
+                ):
+                    skip.add(name)
+                    continue
+                lo[name] = min(lo.get(name, st.min), st.min)
+                hi[name] = max(hi.get(name, st.max), st.max)
+        from ballista_tpu.columnar.arrow_interop import fits_int32
+
+        return frozenset(
+            name
+            for name in lo
+            if name not in skip and fits_int32(lo[name], hi[name])
+        )

@@ -71,6 +71,7 @@ class ShuffleReaderExec(ExecutionPlan):
             yield DeviceBatch.empty(self._schema)
             return
         any_rows = False
+        batch_rows = min(BATCH_ROWS, ctx.config.tpu_batch_rows())
         for loc in locs:
             with self.metrics.time("fetch_time"):
                 t = fetch_partition_table(loc)
@@ -78,7 +79,10 @@ class ShuffleReaderExec(ExecutionPlan):
             if t.num_rows == 0:
                 continue
             any_rows = True
-            for b in table_from_arrow(t, BATCH_ROWS):
+            # narrowing OFF: shuffle files from different writers must
+            # share one physical layout (a per-file decision would flip
+            # int32/int64 between files and double downstream compiles)
+            for b in table_from_arrow(t, batch_rows, frozenset()):
                 yield b
         if not any_rows:
             yield DeviceBatch.empty(self._schema)

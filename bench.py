@@ -14,8 +14,11 @@ vs_baseline = speedup vs the CPU-executor run (>1 means the device is
 faster; BASELINE.md target is >=5). Detailed per-query timings go to
 BENCH_DETAIL.json and stderr.
 
-Env knobs: BENCH_SF (default 0.1), BENCH_ITERS (default 3),
-BENCH_QUERIES (comma list, default q1,q3,q5,q6,q18), BENCH_SKIP_CPU=1.
+Env knobs: BENCH_SF (default 1; 0.1 for a quick run), BENCH_ITERS
+(default 3), BENCH_QUERIES (comma list, default q1,q3,q5,q6,q18),
+BENCH_SKIP_CPU=1. At the default SF=1 the device suite needs one cold
+pass of XLA compiles on a fresh cache (~20 min); warm-cache re-runs
+finish in a few minutes.
 """
 
 import json
@@ -28,7 +31,7 @@ import time
 HERE = pathlib.Path(__file__).resolve().parent
 QDIR = HERE / "benchmarks" / "queries"
 
-SF = float(os.environ.get("BENCH_SF", "0.1"))
+SF = float(os.environ.get("BENCH_SF", "1"))
 ITERS = int(os.environ.get("BENCH_ITERS", "3"))
 QUERIES = os.environ.get("BENCH_QUERIES", "q1,q3,q5,q6,q18").split(",")
 
