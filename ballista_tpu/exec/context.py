@@ -27,7 +27,12 @@ from ballista_tpu.exec.base import (
     run_with_capacity_retry,
 )
 from ballista_tpu.exec.planner import PhysicalPlanner, TableProvider
-from ballista_tpu.exec.scan import CsvScanExec, MemoryScanExec, ParquetScanExec
+from ballista_tpu.exec.scan import (
+    AvroScanExec,
+    CsvScanExec,
+    MemoryScanExec,
+    ParquetScanExec,
+)
 from ballista_tpu.plan.logical import LogicalPlan
 from ballista_tpu.plan.optimizer import optimize
 from ballista_tpu.sql import ast
@@ -112,6 +117,16 @@ class TpuContext(Catalog, TableProvider):
         schema = schema_from_arrow(papq.read_schema(path))
         self.tables[name] = _Registered("parquet", schema, path=path)
 
+    def register_avro(self, name: str, path: str) -> None:
+        """ref context.rs register_avro / read_avro. Schema comes from the
+        file HEADER only — no data blocks decoded at registration (parity
+        with register_parquet's footer-only read)."""
+        from ballista_tpu.avro import read_avro_schema
+
+        self.tables[name] = _Registered(
+            "avro", schema_from_arrow(read_avro_schema(path)), path=path
+        )
+
     def deregister_table(self, name: str) -> None:
         self.tables.pop(name, None)
         self._plan_cache.clear()
@@ -128,7 +143,7 @@ class TpuContext(Catalog, TableProvider):
             return None
         if r.kind == "csv":
             return ("csv", r.kw["path"], r.kw["has_header"], r.kw["delimiter"])
-        return ("parquet", r.kw["path"], False, ",")
+        return (r.kind, r.kw["path"], False, ",")
 
     def scan(
         self, table: str, projection: list[str] | None, partitions: int
@@ -149,6 +164,11 @@ class TpuContext(Catalog, TableProvider):
             return CsvScanExec(
                 r.kw["path"], r.schema, r.kw["has_header"], r.kw["delimiter"],
                 projection, partitions, batch_rows=batch_rows,
+            )
+        if r.kind == "avro":
+            return AvroScanExec(
+                r.kw["path"], r.schema, projection, partitions,
+                batch_rows=batch_rows,
             )
         return ParquetScanExec(
             r.kw["path"], r.schema, projection, partitions,
@@ -231,6 +251,8 @@ class TpuContext(Catalog, TableProvider):
             self.register_csv(
                 stmt.name, stmt.location, schema, stmt.has_header, stmt.delimiter
             )
+        elif stmt.stored_as == "avro":
+            self.register_avro(stmt.name, stmt.location)
         else:
             self.register_parquet(stmt.name, stmt.location)
 

@@ -28,6 +28,7 @@ from ballista_tpu.exec.pipeline import (
     RenameExec,
 )
 from ballista_tpu.exec.scan import (
+    AvroScanExec,
     CsvScanExec,
     MemoryScanExec,
     ParquetScanExec,
@@ -99,13 +100,19 @@ class PhysicalPlanner:
     def _plan(self, node: P.LogicalPlan) -> ExecutionPlan:
         if isinstance(node, P.TableScan):
             projection = list(node.projection) if node.projection else None
-            if node.source is not None and node.source[0] in ("csv", "parquet"):
+            if node.source is not None and node.source[0] in (
+                "csv", "parquet", "avro"
+            ):
                 # file tables are self-describing — no shared catalog needed
                 kind, path, has_header, delimiter = node.source
                 if kind == "csv":
                     scan: ExecutionPlan = CsvScanExec(
                         path, node.source_schema, has_header, delimiter,
                         projection, self.partitions,
+                    )
+                elif kind == "avro":
+                    scan = AvroScanExec(
+                        path, node.source_schema, projection, self.partitions,
                     )
                 else:
                     scan = ParquetScanExec(

@@ -121,15 +121,16 @@ class MemoryScanExec(ExecutionPlan):
             yield b
 
 
-class CsvScanExec(ExecutionPlan):
-    """CSV file scan (ref: CsvScanExecNode, ballista.proto:417-429)."""
+class _StagedFileScanExec(ExecutionPlan):
+    """Shared machinery for file scans that parse on host then stage like
+    a memory table: read ONCE per operator, slice per partition, one
+    whole-table narrowing decision (CSV + Avro; Parquet reads row groups
+    per partition and derives narrowing from file statistics instead)."""
 
     def __init__(
         self,
         path: str,
         table_schema: Schema,
-        has_header: bool = True,
-        delimiter: str = ",",
         projection: list[str] | None = None,
         partitions: int = 1,
         batch_rows: int = 1 << 20,
@@ -137,8 +138,6 @@ class CsvScanExec(ExecutionPlan):
         super().__init__()
         self.path = path
         self.table_schema = table_schema
-        self.has_header = has_header
-        self.delimiter = delimiter
         self.projection = projection
         self._schema = (
             table_schema.select(projection) if projection else table_schema
@@ -153,6 +152,47 @@ class CsvScanExec(ExecutionPlan):
 
     def output_partitioning(self):
         return UnknownPartitioning(self.partitions)
+
+    def _read(self) -> pa.Table:  # pragma: no cover — subclasses implement
+        raise NotImplementedError
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        with self.metrics.time("read_time"):
+            t = self._read()
+        if self._narrow_cols is None:
+            # computed ONCE per operator (not per partition) over the full
+            # parsed table, like _read caches the parse itself
+            from ballista_tpu.columnar.arrow_interop import (
+                narrowable_int64_cols,
+            )
+
+            self._narrow_cols = narrowable_int64_cols(t)
+        mem = MemoryScanExec(
+            t, self.table_schema, self.projection, self.partitions,
+            self.batch_rows,
+        )
+        mem.narrow_cols = self._narrow_cols
+        yield from mem.execute(partition, ctx)
+
+
+class CsvScanExec(_StagedFileScanExec):
+    """CSV file scan (ref: CsvScanExecNode, ballista.proto:417-429)."""
+
+    def __init__(
+        self,
+        path: str,
+        table_schema: Schema,
+        has_header: bool = True,
+        delimiter: str = ",",
+        projection: list[str] | None = None,
+        partitions: int = 1,
+        batch_rows: int = 1 << 20,
+    ) -> None:
+        super().__init__(
+            path, table_schema, projection, partitions, batch_rows
+        )
+        self.has_header = has_header
+        self.delimiter = delimiter
 
     def describe(self) -> str:
         return f"CsvScanExec: {self.path}, partitions={self.partitions}"
@@ -176,23 +216,21 @@ class CsvScanExec(ExecutionPlan):
             )
         return self._table
 
-    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
-        with self.metrics.time("read_time"):
-            t = self._read()
-        if self._narrow_cols is None:
-            # computed ONCE per operator (not per partition) over the full
-            # parsed table, like _read caches the parse itself
-            from ballista_tpu.columnar.arrow_interop import (
-                narrowable_int64_cols,
-            )
 
-            self._narrow_cols = narrowable_int64_cols(t)
-        mem = MemoryScanExec(
-            t, self.table_schema, self.projection, self.partitions,
-            self.batch_rows,
-        )
-        mem.narrow_cols = self._narrow_cols
-        yield from mem.execute(partition, ctx)
+class AvroScanExec(_StagedFileScanExec):
+    """Avro file scan (ref: AvroFormat in DataFusion's ListingTable; the
+    reference serializes AvroScanExecNode alongside CSV/Parquet at
+    ballista.proto:60-92). Decoded on host by ballista_tpu.avro."""
+
+    def describe(self) -> str:
+        return f"AvroScanExec: {self.path}, partitions={self.partitions}"
+
+    def _read(self) -> pa.Table:
+        if self._table is None:
+            from ballista_tpu.avro import read_avro
+
+            self._table = read_avro(self.path)
+        return self._table
 
 
 def _stat_value(v, dtype: DataType):

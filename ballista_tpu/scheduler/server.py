@@ -107,7 +107,16 @@ class QueryStageScheduler(EventAction):
             s._offer_resources()
             return None
         if isinstance(event, JobSubmitted):
-            s._generate_stages(event.job_id, event.plan)
+            try:
+                s._generate_stages(event.job_id, event.plan)
+            except Exception as e:  # noqa: BLE001
+                # stage persistence/serialization failures after planning
+                # must FAIL the job — an escaped exception here previously
+                # left it "running" forever (clients poll indefinitely)
+                log.exception("stage submission failed for %s", event.job_id)
+                s._on_job_failed(
+                    event.job_id, f"stage submission failed: {e}"
+                )
         elif isinstance(event, StageFinished):
             s._on_stage_finished(event.job_id, event.stage_id)
         elif isinstance(event, JobFinished):
@@ -478,12 +487,18 @@ class SchedulerServer:
             return
         job.status = "failed"
         job.error = error
-        if self.state is not None:
-            self.state.save_job(job)
-        # without this, the failed job's PENDING tasks stay schedulable
-        # forever: push mode would hot-loop JobFailed<->ReviveOffers on an
-        # unresolvable stage, and KEDA would never see the cluster go idle
+        # stage cleanup FIRST, and the write-through guarded: failure may
+        # be the persistence backend itself, and skipping cleanup would
+        # leave the failed job's PENDING tasks schedulable forever (push
+        # mode hot-loops JobFailed<->ReviveOffers on an unresolvable
+        # stage, and KEDA never sees the cluster go idle)
         self.stage_manager.remove_job_stages(job_id)
+        if self.state is not None:
+            try:
+                self.state.save_job(job)
+            except Exception:  # noqa: BLE001 — in-memory state still marks
+                # the job failed; clients polling status get the error
+                log.exception("persisting failed-job record for %s", job_id)
         log.error("job %s failed: %s", job_id, error)
 
     # -- task handout (pull mode; ref grpc.rs:121-147) -----------------------

@@ -28,7 +28,12 @@ from ballista_tpu.exec.pipeline import (
 )
 from ballista_tpu.exec.planner import TableProvider
 from ballista_tpu.exec.repartition import HashRepartitionExec
-from ballista_tpu.exec.scan import CsvScanExec, MemoryScanExec, ParquetScanExec
+from ballista_tpu.exec.scan import (
+    AvroScanExec,
+    CsvScanExec,
+    MemoryScanExec,
+    ParquetScanExec,
+)
 from ballista_tpu.exec.sort import GlobalLimitExec, SortExec
 from ballista_tpu.expr import logical as L
 from ballista_tpu.plan import logical as P
@@ -528,7 +533,10 @@ class BallistaCodec:
         from ballista_tpu.executor.reader import ShuffleReaderExec
         from ballista_tpu.distributed_plan import UnresolvedShuffleExec
 
-        if isinstance(plan, (MemoryScanExec, CsvScanExec, ParquetScanExec)):
+        if isinstance(
+            plan,
+            (MemoryScanExec, CsvScanExec, ParquetScanExec, AvroScanExec),
+        ):
             return self._scan_to_proto(plan)
         if isinstance(plan, FilterExec):
             return pb.PhysicalPlanNode(
@@ -718,6 +726,18 @@ class BallistaCodec:
                     partitions=plan.partitions,
                 )
             )
+        if isinstance(plan, AvroScanExec):
+            return pb.PhysicalPlanNode(
+                scan=pb.ScanExecNode(
+                    table_name=getattr(plan, "table_name", ""),
+                    kind="avro",
+                    path=plan.path,
+                    table_schema=schema_to_proto(plan.table_schema),
+                    projection=plan.projection or [],
+                    has_projection=plan.projection is not None,
+                    partitions=plan.partitions,
+                )
+            )
         return pb.PhysicalPlanNode(
             scan=pb.ScanExecNode(
                 table_name=getattr(plan, "table_name", ""),
@@ -878,6 +898,10 @@ class BallistaCodec:
             return CsvScanExec(
                 n.path, schema, n.has_header, n.delimiter or ",",
                 projection, n.partitions or 1,
+            )
+        if n.kind == "avro":
+            return AvroScanExec(
+                n.path, schema, projection, n.partitions or 1,
             )
         return ParquetScanExec(
             n.path, schema, projection, n.partitions or 1,

@@ -170,7 +170,7 @@ class Parser:
         self.expect_kw("as")
         fmt_tok = self.next()
         stored_as = fmt_tok.value.lower()
-        if stored_as not in ("csv", "parquet"):
+        if stored_as not in ("csv", "parquet", "avro"):
             raise SqlError(f"unsupported storage format {stored_as!r}")
         has_header = False
         delimiter = ","

@@ -1,0 +1,53 @@
+"""A job whose stage plans cannot be serialized/persisted must FAIL, not
+hang: the reference records JobFailed and clients see the error
+(query_stage_scheduler.rs:389-400). Regression for the bug where an
+exception escaping stage submission after planning left the job
+"running" forever while the client polled indefinitely."""
+
+import time
+
+from ballista_tpu.exec.base import ExecutionPlan, UnknownPartitioning
+from ballista_tpu.exec.context import TpuContext
+from ballista_tpu.datatypes import Schema, Field, DataType
+from ballista_tpu.scheduler.server import SchedulerServer
+from ballista_tpu.scheduler.state_backend import MemoryBackend
+
+
+class _UnserializablePlan(ExecutionPlan):
+    """No serde arm exists for this node."""
+
+    def schema(self) -> Schema:
+        return Schema([Field("x", DataType.INT64, False)])
+
+    def output_partitioning(self):
+        return UnknownPartitioning(1)
+
+    def describe(self) -> str:
+        return "UnserializablePlan"
+
+    def execute(self, partition, ctx):  # pragma: no cover
+        yield from ()
+
+
+def test_unserializable_stage_plan_fails_job():
+    ctx = TpuContext()
+    # the write-through state backend forces stage-plan serialization at
+    # submission time — the failing path under test
+    server = SchedulerServer(provider=ctx, state_backend=MemoryBackend())
+    try:
+        session = server.get_or_create_session("", {})
+        job_id = server.submit_physical(_UnserializablePlan(), session)
+        deadline = time.time() + 10
+        st = None
+        while time.time() < deadline:
+            st = server.job_status_proto(job_id)
+            if st.WhichOneof("status") == "failed":
+                break
+            time.sleep(0.05)
+        assert st is not None and st.WhichOneof("status") == "failed", (
+            f"job wedged instead of failing: {st}"
+        )
+        err = st.failed.error
+        assert "UnserializablePlan" in err or "serialize" in err, err
+    finally:
+        server.shutdown()
