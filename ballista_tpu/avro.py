@@ -224,8 +224,14 @@ def read_avro(path: str) -> pa.Table:
             arrays.append(pa.array(col, type=pa.int64()).cast(t))
         else:
             arrays.append(pa.array(col, type=t))
-    return pa.table(
-        {fd.name: arr for fd, arr in zip(fields, arrays)}
+    return pa.Table.from_arrays(
+        arrays,
+        schema=pa.schema(
+            [
+                pa.field(fd.name, arr.type, fd.nullable)
+                for fd, arr in zip(fields, arrays)
+            ]
+        ),
     )
 
 
@@ -290,6 +296,8 @@ def write_avro(
     block_rows: int = 64 * 1024,
 ) -> None:
     """Write a pyarrow Table as an Avro object container file."""
+    if codec not in ("null", "deflate"):
+        raise SchemaError(f"unsupported Avro codec {codec!r}")
     schemas = [_avro_field_schema(f) for f in table.schema]
     root = {"type": "record", "name": "row", "fields": schemas}
     plain = []

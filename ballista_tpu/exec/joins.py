@@ -243,6 +243,7 @@ class HashJoinExec(ExecutionPlan):
         checked inside _probe_or_expand's flag fetch), probe or expand,
         relabel the output to the plan schema."""
         bt = None
+        site = None
         fp = self._strategy_key(self.right, right_keys, ctx, partition)
         for b in self.left.execute(partition, ctx):
             bb, pb = self._unify_key_dicts(build_batch, b, right_keys, left_keys)
@@ -257,7 +258,14 @@ class HashJoinExec(ExecutionPlan):
                 # probe++build == left++right; relabel to the plan schema
                 out = self._restore_column_order(out, pb, bt.batch, True)
             self.metrics.add("output_batches")
-            yield out
+            # selective joins (q18's SEMI against a tiny HAVING set) leave
+            # a near-empty batch at full probe capacity — re-bucket so the
+            # rest of the plan runs at the data's true scale
+            from ballista_tpu.exec.shrink import maybe_shrink
+
+            if site is None:
+                site = self.display()
+            yield maybe_shrink(out, ctx, site, partition)
 
     def _execute_inner(
         self, partition, ctx, left_keys, right_keys

@@ -58,12 +58,20 @@ class FilterExec(ExecutionPlan):
         return self._fn
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        from ballista_tpu.exec.shrink import maybe_shrink
+
         fn = self.batch_fn()
+        site = None
         for b in self.input.execute(partition, ctx):
             with self.metrics.time("filter_time"):
                 out = fn(b)
             self.metrics.add("input_batches")
-            yield out
+            # highly selective filters (q18's HAVING keeps ~60 of 1.5M
+            # groups) re-bucket to a learned small capacity so downstream
+            # sorts/gathers run at the data's true scale
+            if site is None:
+                site = self.display()
+            yield maybe_shrink(out, ctx, site, partition)
 
 
 class ProjectionExec(ExecutionPlan):
