@@ -242,6 +242,8 @@ class HashJoinExec(ExecutionPlan):
         rebuild only when remapping changed the build side (overflow is
         checked inside _probe_or_expand's flag fetch), probe or expand,
         relabel the output to the plan schema."""
+        from ballista_tpu.exec.shrink import maybe_shrink
+
         bt = None
         site = None
         fp = self._strategy_key(self.right, right_keys, ctx, partition)
@@ -261,8 +263,6 @@ class HashJoinExec(ExecutionPlan):
             # selective joins (q18's SEMI against a tiny HAVING set) leave
             # a near-empty batch at full probe capacity — re-bucket so the
             # rest of the plan runs at the data's true scale
-            from ballista_tpu.exec.shrink import maybe_shrink
-
             if site is None:
                 site = self.display()
             yield maybe_shrink(out, ctx, site, partition)

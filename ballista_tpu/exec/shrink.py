@@ -97,7 +97,11 @@ def maybe_shrink(
     cap = batch.capacity
     if cap <= SHRINK_MIN_CAP:
         return batch
-    key = ("shrink", getattr(ctx, "job_id", ""), site_display, partition, cap)
+    # NO job_id in the key (unlike join strategy flags): a structural
+    # collision across jobs merely fires the validation flag and re-learns,
+    # while job scoping would cost every distributed query a blocking
+    # first-sight sync per site (executors share one plan cache)
+    key = ("shrink", site_display, partition, cap)
     cache = ctx.plan_cache
     synced = ctx.run_state.setdefault("synced_caps", set())
     cached = cache.get(key)

@@ -138,6 +138,12 @@ def _stacked_scatter_set(rid, capacity: int, cols: list) -> list:
     return out
 
 
+# One-hot-matmul reduction limits: slot count must stay MXU-friendly and
+# the materialized (P, n) f64 one-hot must fit comfortably in HBM.
+_MATMUL_MAX_SLOTS = 2048
+_MATMUL_MAX_ONEHOT_BYTES = 2 << 30
+
+
 def _stacked_reduce(
     rid, capacity: int, vals: list, lives: list, ops: tuple
 ) -> tuple[list, list]:
@@ -147,16 +153,70 @@ def _stacked_reduce(
     NULL masks are folded into the *contribution* instead of the index
     (SUM adds 0, MIN/MAX add their identity, COUNT adds 0) so every column
     shares the same scatter. The non-null count matrix doubles as COUNT
-    output and the SQL all-NULL flags."""
+    output and the SQL all-NULL flags.
+
+    Small slot counts (the dense dictionary-key path — TPC-H q1 has 12)
+    route f64 sums and the count matrix over the MXU instead: a one-hot
+    (P, n) f64 matmul is ~2x the speed of even the stacked scatter on a
+    v5e (measured 45ms vs 100ms net for 1M rows x 8 columns). Counts are
+    exact through f64 (< 2^53); int64 sums keep the scatter (their sums
+    may exceed f64's exact-integer range)."""
     m = len(vals)
     out_vals: list = [None] * m
     out_val_nulls: list = [None] * m
     if m == 0:
         return out_vals, out_val_nulls
-    cnt_mat = jnp.stack([l.astype(jnp.int64) for l in lives], axis=1)
-    nonnull = jnp.zeros((capacity, m), dtype=jnp.int64).at[rid].add(
-        cnt_mat, mode="drop"
-    )
+    n = rid.shape[0]
+    use_mm = capacity <= _MATMUL_MAX_SLOTS
+
+    # chunk so the materialized (capacity, chunk) f64 one-hot stays within
+    # budget; rows beyond n (chunk padding) and dropped rows (rid ==
+    # capacity) match no iota slot, so they contribute nothing
+    chunk = n
+    if use_mm and capacity * n * 8 > _MATMUL_MAX_ONEHOT_BYTES:
+        chunk = max(1 << 17, _MATMUL_MAX_ONEHOT_BYTES // (capacity * 8))
+        chunk = min(chunk, n)
+
+    def _mm(stacked_f64):
+        if chunk == n:
+            oh = (
+                jax.lax.broadcasted_iota(jnp.int32, (capacity, n), 0)
+                == rid[None, :]
+            ).astype(jnp.float64)
+            return jax.lax.dot_general(
+                oh, stacked_f64, (((1,), (0,)), ((), ()))
+            )
+        nb = -(-n // chunk)
+        pad = nb * chunk - n
+        rid_p = jnp.pad(rid, (0, pad), constant_values=capacity)
+        st_p = jnp.pad(stacked_f64, ((0, pad), (0, 0)))
+        iota = jax.lax.broadcasted_iota(jnp.int32, (capacity, chunk), 0)
+
+        def body(acc, xs):
+            rid_c, st_c = xs
+            oh = (iota == rid_c[None, :]).astype(jnp.float64)
+            return acc + jax.lax.dot_general(
+                oh, st_c, (((1,), (0,)), ((), ()))
+            ), None
+
+        acc, _ = jax.lax.scan(
+            body,
+            jnp.zeros((capacity, stacked_f64.shape[1])),
+            (
+                rid_p.reshape(nb, chunk),
+                st_p.reshape(nb, chunk, stacked_f64.shape[1]),
+            ),
+        )
+        return acc
+
+    if use_mm:
+        cnt_mat = jnp.stack([l.astype(jnp.float64) for l in lives], axis=1)
+        nonnull = _mm(cnt_mat).astype(jnp.int64)
+    else:
+        cnt_mat = jnp.stack([l.astype(jnp.int64) for l in lives], axis=1)
+        nonnull = jnp.zeros((capacity, m), dtype=jnp.int64).at[rid].add(
+            cnt_mat, mode="drop"
+        )
     add_groups: dict[str, list] = {}
     min_groups: dict[str, list] = {}
     max_groups: dict[str, list] = {}
@@ -168,7 +228,9 @@ def _stacked_reduce(
         if op == AggOp.SUM:
             acc_t = _sum_dtype(vc.dtype)
             contrib = jnp.where(live, vc, jnp.zeros_like(vc)).astype(acc_t)
-            add_groups.setdefault(str(acc_t), []).append((i, contrib))
+            add_groups.setdefault(
+                str(jnp.dtype(acc_t)), []
+            ).append((i, contrib))
         elif op == AggOp.MIN:
             masked = jnp.where(live, vc, _max_ident(vc.dtype))
             min_groups.setdefault(str(vc.dtype), []).append((i, masked))
@@ -182,7 +244,9 @@ def _stacked_reduce(
     ):
         for dt, entries in groups.items():
             stacked = jnp.stack([c for _, c in entries], axis=1)
-            if kind == "add":
+            if kind == "add" and use_mm and dt == "float64":
+                res = _mm(stacked)
+            elif kind == "add":
                 init = jnp.zeros((capacity, len(entries)), stacked.dtype)
                 res = init.at[rid].add(stacked, mode="drop")
             elif kind == "min":

@@ -1,0 +1,152 @@
+"""Adaptive capacity shrink (exec/shrink.py): the static-shape engine's
+answer to selectivity. Covers the learn/speculate/invalidate state
+machine and end-to-end correctness through a q18-shaped query."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.columnar.arrow_interop import batch_from_arrow
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.errors import SpeculationMiss
+from ballista_tpu.exec.base import TaskContext
+from ballista_tpu.exec.context import TpuContext
+from ballista_tpu.exec.shrink import (
+    SHRINK_MIN_CAP,
+    maybe_shrink,
+)
+
+
+def _batch(n_rows: int, live: int):
+    t = pa.table(
+        {
+            "k": pa.array(np.arange(n_rows, dtype=np.int64)),
+            "v": pa.array(np.random.default_rng(0).random(n_rows)),
+        }
+    )
+    b = batch_from_arrow(t)
+    import jax.numpy as jnp
+
+    mask = jnp.arange(b.capacity) < live
+    return b.with_valid(b.valid & mask)
+
+
+def _ctx(cache):
+    return TaskContext(config=BallistaConfig(), plan_cache=cache)
+
+
+def test_learns_and_shrinks_sparse_batch():
+    cache: dict = {}
+    b = _batch(1 << 19, live=100)
+    ctx = _ctx(cache)
+    out = maybe_shrink(b, ctx, "site", 0)
+    assert out.capacity < b.capacity
+    assert out.capacity >= 100
+    assert int(out.count_valid()) == 100
+    # learned entry present and reused speculatively on a fresh run
+    (key,) = [k for k in cache if k[0] == "shrink"]
+    assert cache[key] == out.capacity
+    ctx2 = _ctx(cache)
+    out2 = maybe_shrink(b, ctx2, "site", 0)
+    assert out2.capacity == out.capacity
+    assert ctx2.speculative_checks, "warm path must validate, not trust"
+    ctx2.raise_deferred()  # flag must NOT fire for unchanged data
+
+
+def test_rows_survive_shrink_exactly():
+    cache: dict = {}
+    b = _batch(1 << 19, live=57)
+    out = maybe_shrink(b, _ctx(cache), "site", 0)
+    import numpy as np_
+
+    live_k = np_.asarray(b.columns[0])[np_.asarray(b.valid)]
+    out_k = np_.asarray(out.columns[0])[np_.asarray(out.valid)]
+    assert sorted(live_k.tolist()) == sorted(out_k.tolist())
+
+
+def test_dense_batch_not_shrunk_and_sticky():
+    cache: dict = {}
+    b = _batch(1 << 19, live=(1 << 18))  # 50% live: ratio test fails
+    ctx = _ctx(cache)
+    out = maybe_shrink(b, ctx, "site", 0)
+    assert out is b
+    (key,) = [k for k in cache if k[0] == "shrink"]
+    assert cache[key] == 0
+    # a later sparse batch at the SAME site must not overwrite the sticky 0
+    sparse = _batch(1 << 19, live=10)
+    out2 = maybe_shrink(sparse, ctx, "site", 0)
+    assert out2 is sparse
+    assert cache[key] == 0
+
+
+def test_grown_input_fires_speculation():
+    cache: dict = {}
+    small = _batch(1 << 19, live=20)
+    maybe_shrink(small, _ctx(cache), "site", 0)
+    # fresh run, same site, MANY more live rows than the learned capacity
+    grown = _batch(1 << 19, live=1 << 17)
+    ctx = _ctx(cache)
+    maybe_shrink(grown, ctx, "site", 0)
+    with pytest.raises(SpeculationMiss):
+        ctx.raise_deferred()
+
+
+def test_small_capacity_untouched():
+    cache: dict = {}
+    b = _batch(SHRINK_MIN_CAP // 2, live=1)
+    assert maybe_shrink(b, _ctx(cache), "site", 0) is b
+    assert not cache
+
+
+def test_no_cache_is_noop():
+    b = _batch(1 << 19, live=1)
+    assert maybe_shrink(b, TaskContext(config=BallistaConfig()), "s", 0) is b
+
+
+def test_q18_shape_end_to_end_matches_pandas():
+    """Selective HAVING + semi-join + join + group-by: the sites that
+    shrink in production, validated against a pandas oracle across two
+    runs (learn, then speculate)."""
+    rng = np.random.default_rng(7)
+    n = 60_000
+    li = pa.table(
+        {
+            "ok": pa.array(rng.integers(0, 15_000, n).astype(np.int64)),
+            "qty": pa.array(rng.uniform(1, 50, n)),
+        }
+    )
+    orders = pa.table(
+        {
+            "ok": pa.array(np.arange(15_000, dtype=np.int64)),
+            "total": pa.array(rng.uniform(10, 1000, 15_000)),
+        }
+    )
+    ctx = TpuContext(BallistaConfig())
+    ctx.register_table("li", li)
+    ctx.register_table("ord", orders)
+    sql = (
+        "SELECT o.ok, o.total, SUM(l.qty) AS q FROM ord o, li l "
+        "WHERE o.ok = l.ok AND o.ok IN "
+        "(SELECT ok FROM li GROUP BY ok HAVING SUM(qty) > 220) "
+        "GROUP BY o.ok, o.total ORDER BY q DESC, o.ok LIMIT 10"
+    )
+    lp = li.to_pandas()
+    op = orders.to_pandas()
+    sums = lp.groupby("ok")["qty"].sum()
+    keep = set(sums[sums > 220].index)
+    j = op[op.ok.isin(keep)].merge(lp[lp.ok.isin(keep)], on="ok")
+    exp = (
+        j.groupby(["ok", "total"], as_index=False)["qty"]
+        .sum()
+        .rename(columns={"qty": "q"})
+        .sort_values(["q", "ok"], ascending=[False, True])
+        .head(10)
+        .reset_index(drop=True)
+    )
+    for _ in range(2):  # run 1 learns, run 2 speculates
+        res = ctx.sql(sql).collect().to_pandas()
+        assert len(res) == len(exp)
+        assert res["o.ok"].tolist() == exp["ok"].tolist()
+        np.testing.assert_allclose(
+            res["q"].to_numpy(), exp["q"].to_numpy(), rtol=1e-9
+        )
