@@ -139,9 +139,12 @@ def _stacked_scatter_set(rid, capacity: int, cols: list) -> list:
 
 
 # One-hot-matmul reduction limits: slot count must stay MXU-friendly and
-# the materialized (P, n) f64 one-hot must fit comfortably in HBM.
+# the materialized (P, chunk) f64 one-hot must fit comfortably in HBM —
+# the TPU x64 rewrite emulates f64 as f32 pairs, so the dot's temporaries
+# run ~3x the nominal operand size (a 2GB budget OOM'd 16GB HBM on an
+# 8M-row q5 aggregate next to the join intermediates).
 _MATMUL_MAX_SLOTS = 2048
-_MATMUL_MAX_ONEHOT_BYTES = 2 << 30
+_MATMUL_MAX_ONEHOT_BYTES = 512 << 20
 
 
 def _stacked_reduce(
@@ -174,7 +177,7 @@ def _stacked_reduce(
     # capacity) match no iota slot, so they contribute nothing
     chunk = n
     if use_mm and capacity * n * 8 > _MATMUL_MAX_ONEHOT_BYTES:
-        chunk = max(1 << 17, _MATMUL_MAX_ONEHOT_BYTES // (capacity * 8))
+        chunk = max(1 << 15, _MATMUL_MAX_ONEHOT_BYTES // (capacity * 8))
         chunk = min(chunk, n)
 
     def _mm(stacked_f64):
