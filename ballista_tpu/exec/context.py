@@ -151,28 +151,28 @@ class TpuContext(Catalog, TableProvider):
         r = self.tables.get(table)
         if r is None:
             raise PlanError(f"table {table!r} not found")
-        batch_rows = self.config.tpu_batch_rows()
+        # batch_rows resolves at execute time from the task's session
+        # config, so it follows ballista.tpu.batch_rows across process
+        # boundaries (decoded stage plans carry the config, not the knob)
         if r.kind == "memory":
             # table-lifetime device cache: warm queries re-serve resident
             # device arrays instead of re-uploading the table
             cache = r.kw.setdefault("device_cache", {})
             return MemoryScanExec(
                 r.kw["table"], r.schema, projection, partitions,
-                batch_rows=batch_rows, device_cache=cache,
+                device_cache=cache,
             )
         if r.kind == "csv":
             return CsvScanExec(
                 r.kw["path"], r.schema, r.kw["has_header"], r.kw["delimiter"],
-                projection, partitions, batch_rows=batch_rows,
+                projection, partitions,
             )
         if r.kind == "avro":
             return AvroScanExec(
                 r.kw["path"], r.schema, projection, partitions,
-                batch_rows=batch_rows,
             )
         return ParquetScanExec(
             r.kw["path"], r.schema, projection, partitions,
-            batch_rows=batch_rows,
         )
 
     # -- SQL -----------------------------------------------------------------

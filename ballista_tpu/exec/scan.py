@@ -44,7 +44,7 @@ class MemoryScanExec(ExecutionPlan):
         out_schema: Schema,
         projection: list[str] | None = None,
         partitions: int = 1,
-        batch_rows: int = 1 << 20,
+        batch_rows: int | None = None,
         device_cache: dict | None = None,
     ) -> None:
         """``device_cache``: an (optionally shared, table-lifetime) dict the
@@ -79,8 +79,12 @@ class MemoryScanExec(ExecutionPlan):
         return f"MemoryScanExec: cols={cols}, partitions={self.partitions}"
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        # resolved per task so ballista.tpu.batch_rows travels with the
+        # session config across process boundaries (decoded stage plans
+        # carry no batch_rows; the config does)
+        batch_rows = self.batch_rows or ctx.config.tpu_batch_rows()
         key = (
-            tuple(self.projection or ()), self.partitions, self.batch_rows,
+            tuple(self.projection or ()), self.partitions, batch_rows,
             partition,
         )
         if self.device_cache is not None:
@@ -110,7 +114,7 @@ class MemoryScanExec(ExecutionPlan):
 
                 self.narrow_cols = narrowable_int64_cols(t)
             out = list(
-                table_from_arrow(chunk, self.batch_rows, self.narrow_cols)
+                table_from_arrow(chunk, batch_rows, self.narrow_cols)
             )
         if self.device_cache is not None:
             self.device_cache[key] = out
@@ -133,7 +137,7 @@ class _StagedFileScanExec(ExecutionPlan):
         table_schema: Schema,
         projection: list[str] | None = None,
         partitions: int = 1,
-        batch_rows: int = 1 << 20,
+        batch_rows: int | None = None,
     ) -> None:
         super().__init__()
         self.path = path
@@ -186,7 +190,7 @@ class CsvScanExec(_StagedFileScanExec):
         delimiter: str = ",",
         projection: list[str] | None = None,
         partitions: int = 1,
-        batch_rows: int = 1 << 20,
+        batch_rows: int | None = None,
     ) -> None:
         super().__init__(
             path, table_schema, projection, partitions, batch_rows
@@ -362,7 +366,7 @@ class ParquetScanExec(ExecutionPlan):
         table_schema: Schema,
         projection: list[str] | None = None,
         partitions: int = 1,
-        batch_rows: int = 1 << 20,
+        batch_rows: int | None = None,
         predicates: list | None = None,
     ) -> None:
         super().__init__()
