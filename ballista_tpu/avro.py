@@ -73,6 +73,13 @@ def _read_bytes(buf: io.BytesIO) -> bytes:
     return data
 
 
+def _read_exact(buf: io.BytesIO, n: int, what: str) -> bytes:
+    data = buf.read(n)
+    if len(data) != n:
+        raise SchemaError(f"truncated Avro {what}")
+    return data
+
+
 # -- schema ------------------------------------------------------------------
 
 
@@ -129,11 +136,11 @@ class _FieldDec:
         if t in ("int", "long"):
             return _read_long(buf)
         if t == "boolean":
-            return buf.read(1) == b"\x01"
+            return _read_exact(buf, 1, "boolean") == b"\x01"
         if t == "float":
-            return struct.unpack("<f", buf.read(4))[0]
+            return struct.unpack("<f", _read_exact(buf, 4, "float"))[0]
         if t == "double":
-            return struct.unpack("<d", buf.read(8))[0]
+            return struct.unpack("<d", _read_exact(buf, 8, "double"))[0]
         if t == "string":
             return _read_bytes(buf).decode("utf-8")
         return _read_bytes(buf)  # bytes
@@ -173,11 +180,20 @@ def _read_header(buf: io.BytesIO, path: str) -> dict[str, bytes]:
 def read_avro_schema(path: str) -> pa.Schema:
     """Arrow schema of an Avro file from the header alone — no data blocks
     are decoded (registration parity with papq.read_schema)."""
-    with open(path, "rb") as f:
-        head = f.read(64 * 1024)  # header = magic + metadata map, small
-    fields = _parse_schema(
-        _read_header(io.BytesIO(head), path)["avro.schema"].decode("utf-8")
-    )
+    size = 64 * 1024  # header = magic + metadata map, usually small
+    while True:
+        with open(path, "rb") as f:
+            head = f.read(size)
+        try:
+            meta = _read_header(io.BytesIO(head), path)
+            break
+        except SchemaError:
+            # a very wide schema / extra metadata can exceed the buffer;
+            # retry doubled until the whole file has been read once
+            if len(head) < size:
+                raise
+            size *= 2
+    fields = _parse_schema(meta["avro.schema"].decode("utf-8"))
     return pa.schema(
         [pa.field(fd.name, fd.arrow_type(), fd.nullable) for fd in fields]
     )

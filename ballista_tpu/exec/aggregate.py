@@ -421,15 +421,16 @@ class HashAggregateExec(ExecutionPlan):
         cap: int,
         from_state: bool,
         ctx: TaskContext | None = None,
+        site: str | None = None,
     ) -> DeviceBatch:
         """One jitted group_aggregate pass -> state-shaped DeviceBatch.
         ``from_state``: value columns are already state slots (merge pass);
         otherwise they come from the pre-projection via each slot's ``src``
         (first partial pass). The overflow flag is deferred to the task
         boundary (one batched fetch) instead of a per-pass device sync."""
-        # group_aggregate host-composes cached sort passes + a jitted
-        # finisher — do NOT wrap it in another jit (that would re-inline the
-        # sorts into one slow-compiling program).
+        # group_aggregate host-composes cached sort passes + jitted
+        # finishers — do NOT wrap it in another jit (that would re-inline
+        # the sorts into one slow-compiling program).
         key_cols = [batch.columns[i] for i in range(n_groups)]
         key_nulls = [batch.nulls[i] for i in range(n_groups)]
         val_cols, val_nulls = [], []
@@ -457,10 +458,37 @@ class HashAggregateExec(ExecutionPlan):
                 val_nulls, list(ops),
             )
         else:
+            # Clustered-input speculation: when a prior run LEARNED (off
+            # the stable sort's permutation — free) that this site's rows
+            # arrive grouped-adjacent on the keys (TPC-H lineitem grouped
+            # by l_orderkey; merge passes over concatenated clustered
+            # states), skip the sort + gather entirely and validate the
+            # assumption with a deferred flag (stale -> SpeculationMiss
+            # invalidates + retries, the shrink/join-strategy protocol).
+            cache = ctx.plan_cache if ctx is not None else None
+            skey = (
+                ("agg_sorted", site, from_state, batch.capacity)
+                if (cache is not None and site is not None)
+                else None
+            )
+            cached = cache.get(skey) if skey is not None else None
             res = group_aggregate(
                 key_cols, key_nulls, batch.valid, val_cols, val_nulls,
-                list(ops), cap,
+                list(ops), cap, presorted=cached is True,
             )
+            if cached is True:
+                ctx.defer_speculation(
+                    ~res.sorted_ok,
+                    "clustered-input aggregate speculation went stale "
+                    "(rows no longer grouped-adjacent)",
+                    [skey],
+                )
+            elif (
+                skey is not None
+                and cached is None
+                and res.input_was_sorted is not None
+            ):
+                ctx.defer_learn(skey, res.input_was_sorted)
         if ctx is not None:
             ctx.defer_check(
                 res.overflow,
@@ -551,11 +579,13 @@ class HashAggregateExec(ExecutionPlan):
             return
 
         partials: list[DeviceBatch] = []
+        site = self.display()
         for b in pre.execute(partition, ctx):
             with self.metrics.time("agg_time"):
                 partials.append(
                     self._run_group_agg(
-                        b, ops, n_groups, cap, from_state=False, ctx=ctx
+                        b, ops, n_groups, cap, from_state=False, ctx=ctx,
+                        site=site,
                     )
                 )
             self.metrics.add("input_batches")
@@ -565,11 +595,15 @@ class HashAggregateExec(ExecutionPlan):
             yield partials[0]
             return
         # fold this partition's partials once more (merge ops) to bound
-        # shuffle volume
+        # shuffle volume; states are front-compacted, so first slice them
+        # down to a learned capacity (re-bucketing for free) to keep the
+        # fold's row count proportional to actual groups, not capacity
+        partials = self._slice_states(partials, ctx, site, partition)
         merged = concat_batches(partials)
         merge_ops = [s.op.merge_op for s in self.spec.slots]
         yield self._run_group_agg(
-            merged, merge_ops, n_groups, cap, from_state=True, ctx=ctx
+            merged, merge_ops, n_groups, cap, from_state=True, ctx=ctx,
+            site=site + "|fold",
         )
 
     def _scalar_state(self, b: DeviceBatch) -> DeviceBatch:
@@ -633,18 +667,92 @@ class HashAggregateExec(ExecutionPlan):
             # masks rather than concatenates), so its group keys are
             # already unique — the merge aggregation would re-sort the full
             # state capacity to rediscover the same groups. Skip it.
+            # INVARIANT: any producer that starts emitting concatenated
+            # UN-folded states (today none do — partials fold per
+            # partition, shuffle reads that split a file yield >1 batch)
+            # must also stop this skip, or duplicate groups pass through.
             # (Timed under merge_time so per-query metric reports stay
             # comparable with the merging shape.)
             with self.metrics.time("merge_time"):
                 out = self._finalize(states[0], n_groups)
             yield out
             return
+        site = self.display()
+        states = self._slice_states(states, ctx, site, partition)
         merged = concat_batches(states)
         with self.metrics.time("merge_time"):
             state = self._run_group_agg(
-                merged, merge_ops, n_groups, cap, from_state=True, ctx=ctx
+                merged, merge_ops, n_groups, cap, from_state=True, ctx=ctx,
+                site=site,
             )
         yield self._finalize(state, n_groups)
+
+    def _slice_states(
+        self,
+        states: list[DeviceBatch],
+        ctx: TaskContext | None,
+        site: str,
+        partition: int,
+    ) -> list[DeviceBatch]:
+        """Slice front-compacted partial states down to a learned capacity
+        before a merge fold. A partial state's live groups occupy a prefix
+        (valid = iota < n_groups), so re-bucketing is a free device slice —
+        no compaction pass — and the merge's sort/segment work then scales
+        with actual groups, not with the padded state capacity (a q3-shaped
+        fold drops from 3x2M to 3x1M rows). The capacity is learned via the
+        plan cache and validated with a deferred flag, like exec/shrink."""
+        if ctx is None or ctx.plan_cache is None:
+            return states
+        import jax.numpy as jnp
+
+        from ballista_tpu.columnar.batch import round_capacity
+
+        cache = ctx.plan_cache
+        key = ("agg_state_cap", site, partition)
+        # Slicing assumes live groups occupy a PREFIX. True for partial
+        # outputs (valid = iota < n_groups) but NOT for states that came
+        # through an in-place-masking hash repartition, whose live rows are
+        # scattered over the producer's whole prefix — so prefix-validity
+        # is learned as its own flag (AND-ed across states), and every
+        # slice is additionally validated by "no live row beyond the
+        # slice", which catches layout drift exactly.
+        pkey = ("agg_state_prefix", site, partition)
+        learned = cache.get(key)
+        prefix_ok = cache.get(pkey)
+        if learned is None or prefix_ok is None:
+            for st in states:
+                n = st.count_valid()
+                ctx.defer_learn(key, n)
+                iota = jnp.arange(st.capacity, dtype=jnp.int32)
+                ctx.defer_learn(pkey, jnp.all(st.valid == (iota < n)))
+            return states
+        if prefix_ok is not True:
+            return states
+        slice_cap = round_capacity(max(16, int(learned * 5 // 4)))
+        out = []
+        for st in states:
+            if slice_cap >= st.capacity:
+                out.append(st)
+                continue
+            ctx.defer_speculation(
+                jnp.any(st.valid[slice_cap:]),
+                "learned aggregate-state capacity went stale (live rows "
+                "beyond the slice)",
+                [key, pkey],
+            )
+            out.append(
+                DeviceBatch(
+                    schema=st.schema,
+                    columns=tuple(c[:slice_cap] for c in st.columns),
+                    valid=st.valid[:slice_cap],
+                    nulls=tuple(
+                        None if m is None else m[:slice_cap]
+                        for m in st.nulls
+                    ),
+                    dictionaries=dict(st.dictionaries),
+                )
+            )
+        return out
 
     def _finalize(self, state: DeviceBatch, n_groups: int) -> DeviceBatch:
         return finalize_state(state, self.spec, self._schema)

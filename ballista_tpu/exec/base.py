@@ -58,6 +58,9 @@ class TaskContext:
     plan_cache: dict | None = None
     # validation flags for plan_cache entries: (flag, message, cache_keys)
     speculative_checks: list = dataclasses.field(default_factory=list)
+    # (cache_key, device scalar) pairs written to plan_cache at a CLEAN
+    # task boundary (see defer_learn)
+    learned_values: list = dataclasses.field(default_factory=list)
     # per-run scratch (e.g. which cache keys THIS run has already synced:
     # later batches of the same run must keep syncing/maxing, not
     # speculate against a value a smaller earlier batch just wrote)
@@ -77,8 +80,21 @@ class TaskContext:
         fetch as defer_check — zero extra round trips."""
         self.speculative_checks.append((flag, message, list(cache_keys)))
 
+    def defer_learn(self, cache_key, value) -> None:
+        """Queue a device scalar whose value should be LEARNED into the
+        plan cache at the task boundary (rides the same batched fetch as
+        defer_check). Values for the same key are AND-ed for bools /
+        max-ed for ints across the run's batches; nothing is written if
+        the run fails its checks."""
+        if self.plan_cache is not None:
+            self.learned_values.append((cache_key, value))
+
     def raise_deferred(self) -> None:
-        if not self.deferred_checks and not self.speculative_checks:
+        if (
+            not self.deferred_checks
+            and not self.speculative_checks
+            and not self.learned_values
+        ):
             return
         from ballista_tpu.errors import (
             CapacityError,
@@ -90,6 +106,7 @@ class TaskContext:
         import jax.numpy as jnp
 
         n = len(self.deferred_checks)
+        ns = len(self.speculative_checks)
         fetched = fetch_arrays(
             [jnp.asarray(f) for f, _, _ in self.deferred_checks]
             + [
@@ -97,13 +114,17 @@ class TaskContext:
                 for _, _, r in self.deferred_checks
             ]
             + [jnp.asarray(f) for f, _, _ in self.speculative_checks]
+            + [jnp.asarray(v) for _, v in self.learned_values]
         )
         flags, reqs = fetched[:n], fetched[n : 2 * n]
-        spec_flags = fetched[2 * n :]
+        spec_flags = fetched[2 * n : 2 * n + ns]
+        learned = fetched[2 * n + ns :]
         checks = self.deferred_checks
         spec_checks = self.speculative_checks
+        learn_entries = self.learned_values
         self.deferred_checks = []
         self.speculative_checks = []
+        self.learned_values = []
         # speculation misses first: the run's output is invalid regardless
         # of what the hard checks say (a stale strategy can mask them)
         spec_fired = [
@@ -123,6 +144,23 @@ class TaskContext:
             if bool(f)
         ]
         if not fired:
+            # clean run: commit learned plan-shape facts (AND for bools so
+            # one unsorted batch at a site vetoes the clustered fast path;
+            # max for ints so capacities cover every batch)
+            if self.plan_cache is not None:
+                for (key, _), val in zip(learn_entries, learned):
+                    v = val.item() if hasattr(val, "item") else val
+                    prev = self.plan_cache.get(key)
+                    if isinstance(v, bool) or str(getattr(val, "dtype", "")) == "bool":
+                        v = bool(v)
+                        self.plan_cache[key] = (
+                            v if prev is None else (prev and v)
+                        )
+                    else:
+                        v = int(v)
+                        self.plan_cache[key] = (
+                            v if prev is None else max(prev, v)
+                        )
             return
         msg = "; ".join(dict.fromkeys(m for m, _ in fired))
         required = max((r for _, r in fired), default=0)

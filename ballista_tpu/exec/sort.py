@@ -84,6 +84,9 @@ class SortExec(ExecutionPlan):
         return f"SortExec: [{ks}]{f}"
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        from ballista_tpu.columnar.batch import round_capacity
+        from ballista_tpu.ops.sort import gather_batch, sort_perm
+
         assert partition == 0
         batches = []
         part = self.input.output_partitioning()
@@ -92,12 +95,22 @@ class SortExec(ExecutionPlan):
         if not batches:
             return
         merged = concat_batches(batches)
-        # sort_batch host-composes cached argsort passes — no outer jit
+        # sort_perm host-composes cached argsort passes — no outer jit
         # (that would re-inline the sorts into one slow-compiling program).
         with self.metrics.time("sort_time"):
-            out = sort_batch(merged, self._keys)
             if self.fetch is not None:
-                out = _fetch_program(out.capacity, self.fetch)(out)
+                # TopK: invalid rows sort last, so slicing the PERMUTATION
+                # to the fetch bound makes the gather (and everything
+                # downstream, including the result fetch to host) scale
+                # with the limit, not the input capacity.
+                m = min(
+                    round_capacity(max(self.fetch, 8)), merged.capacity
+                )
+                perm = sort_perm(merged, self._keys)[:m]
+                out = gather_batch(merged, perm)
+                out = _fetch_program(m, self.fetch)(out)
+            else:
+                out = sort_batch(merged, self._keys)
         yield out
 
 
@@ -125,23 +138,48 @@ class GlobalLimitExec(ExecutionPlan):
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
         assert partition == 0
+
+        def batches():
+            part = self.input.output_partitioning()
+            for p in range(part.n):
+                yield from self.input.execute(p, ctx)
+
+        def mask(b, skip, fetch):
+            # rank of live rows within the batch (order-preserving)
+            rank = jnp.cumsum(b.valid.astype(jnp.int32)) - 1
+            keep = b.valid & (rank >= skip)
+            if fetch is not None:
+                keep = keep & (rank < skip + fetch)
+            return b.with_valid(keep)
+
+        it = batches()
+        first = next(it, None)
+        if first is None:
+            return
+        second = next(it, None)
+        if second is None:
+            # single-batch stream (the common shape under a coalesce/sort):
+            # pure device masking, no host sync
+            yield mask(first, self.skip, self.fetch)
+            return
         remaining_skip = self.skip
         remaining = self.fetch
-        part = self.input.output_partitioning()
-        for p in range(part.n):
-            for b in self.input.execute(p, ctx):
-                if remaining is not None and remaining <= 0:
-                    return
-                # rank of live rows within the batch (order-preserving)
-                rank = jnp.cumsum(b.valid.astype(jnp.int32)) - 1
-                keep = b.valid & (rank >= remaining_skip)
-                if remaining is not None:
-                    keep = keep & (rank < remaining_skip + remaining)
-                out = b.with_valid(keep)
-                n_live = int(jnp.sum(b.valid.astype(jnp.int32)))
-                taken = max(0, n_live - remaining_skip)
-                if remaining is not None:
-                    taken = min(taken, remaining)
-                    remaining -= taken
-                remaining_skip = max(0, remaining_skip - n_live)
-                yield out
+
+        def _rest():
+            yield first
+            yield second
+            yield from it
+
+        for b in _rest():
+            if remaining is not None and remaining <= 0:
+                return
+            out = mask(b, remaining_skip, remaining)
+            # multi-batch streams need the live count to carry skip/fetch
+            # across batches — one scalar sync per batch, rare shape
+            n_live = int(jnp.sum(b.valid.astype(jnp.int32)))
+            taken = max(0, n_live - remaining_skip)
+            if remaining is not None:
+                taken = min(taken, remaining)
+                remaining -= taken
+            remaining_skip = max(0, remaining_skip - n_live)
+            yield out

@@ -83,11 +83,19 @@ class GroupAggResult:
     valid: jnp.ndarray  # bool[capacity] — which output slots are groups
     n_groups: jnp.ndarray  # int32 scalar
     overflow: jnp.ndarray  # bool scalar: more groups than capacity
+    # device bool scalars for the clustered-input speculation protocol
+    # (exec/aggregate.py): ``input_was_sorted`` reports whether the rows
+    # came in already grouped-adjacent (learned on sort-path runs, free off
+    # the stable sort's permutation); ``sorted_ok`` validates a
+    # presorted-path run (None on sort-path runs).
+    input_was_sorted: jnp.ndarray | None = None
+    sorted_ok: jnp.ndarray | None = None
 
     def tree_flatten(self):
         return (
             (self.keys, self.key_nulls, self.values, self.value_nulls,
-             self.valid, self.n_groups, self.overflow),
+             self.valid, self.n_groups, self.overflow,
+             self.input_was_sorted, self.sorted_ok),
             None,
         )
 
@@ -267,100 +275,399 @@ def _stacked_reduce(
     return out_vals, out_val_nulls
 
 
-def _agg_finish(
-    perm,
+# -- segment-reduction finisher -----------------------------------------------
+#
+# After the group sort (or on input that is already clustered on the group
+# keys), rows of one group are ADJACENT, so every reduction can avoid the
+# random scatter a hash-grouping design needs. Measured on the v5e (8.4M
+# rows -> 2M groups): a stacked scatter-add runs 0.7-1.1s/column (per-row
+# serial cost), while cumsum + segment-boundary gathers compute the same
+# sums in ~0.25s for TWO columns:
+#
+#   sum[g]   = cumsum(contrib)[end_g] - cumsum(contrib)[start_g] + c[start_g]
+#   count[g] = same over the live flag
+#   keys[g]  = key cols gathered at start_g (first row of the segment)
+#
+# start/end positions come from two scatters of iota (min/max with
+# indices_are_sorted — these run near-sequentially, unlike value scatters).
+# MIN/MAX keep a scatter (no prefix trick) but ride sorted indices.
+#
+# The whole finisher is split into TWO jitted programs: fusing the cumsums,
+# boundary scatters, and boundary gathers into one program SIGSEGVs this
+# toolchain's TPU compiler (reproducible on combined cumsum + 2 scatters +
+# gathers); the split also costs nothing (dispatches are async).
+#
+# f64 SUM NOTE: segment sums via prefix-difference round like a different
+# summation order and carry error proportional to the GLOBAL prefix
+# magnitude (~1e-6 absolute at 8M rows of 1e4-scale money values). SQL
+# does not define a summation order; int64/count sums stay exact (integer
+# cumsum).
+
+
+def _same_val(a, b):
+    """SQL group equality: NaN==NaN is one group; -0.0 == +0.0."""
+    same = a == b
+    if jnp.issubdtype(a.dtype, jnp.floating):
+        same = same | (jnp.isnan(a) & jnp.isnan(b))
+    return same
+
+
+def _gt_val(a, b):
+    """Sort-order 'greater': NaN sorts after every number."""
+    if jnp.issubdtype(a.dtype, jnp.floating):
+        return (a > b) | (jnp.isnan(a) & ~jnp.isnan(b))
+    return a > b
+
+
+def _ffill_tuple(vals: tuple, flag):
+    """Forward-fill ``vals`` from the last flagged row at-or-before each
+    row (Hillis–Steele doubling in a fori_loop — one small loop body; an
+    unrolled associative_scan takes minutes to compile here). Returns
+    (filled values, filled flag)."""
+    n = flag.shape[0]
+    steps = max(1, (n - 1).bit_length())
+    iota = jnp.arange(n, dtype=jnp.int32)
+
+    def body(k, carry):
+        vs, fl = carry
+        off = jnp.left_shift(jnp.int32(1), k)
+        pf = jnp.roll(fl, off) & (iota >= off)
+        take_prev = ~fl & pf
+        new_vs = tuple(
+            jnp.where(take_prev, jnp.roll(v, off), v) for v in vs
+        )
+        return new_vs, fl | pf
+
+    vs, fl = jax.lax.fori_loop(0, steps, body, (tuple(vals), flag))
+    return vs, fl
+
+
+def _seg_layouts(val_dtypes: tuple, null_sig: tuple, ops: tuple):
+    """Static column layouts: which live-count cumsum serves each column
+    (no-null columns share one), how SUM columns stack per accumulator
+    dtype, and which columns reduce by scatter-min/max."""
+    live_keys: list[int] = []
+    live_index: dict[int, int] = {}
+    for i, has_null in enumerate(null_sig):
+        k = i if has_null else -1
+        if k not in live_index:
+            live_index[k] = len(live_keys)
+            live_keys.append(k)
+    sum_groups: dict[str, list[int]] = {}
+    mm_idx: list[int] = []
+    for i, (dt, op) in enumerate(zip(val_dtypes, ops)):
+        if op == AggOp.SUM:
+            acc = str(jnp.dtype(_sum_dtype(jnp.dtype(dt))))
+            sum_groups.setdefault(acc, []).append(i)
+        elif op in (AggOp.MIN, AggOp.MAX):
+            mm_idx.append(i)
+    sum_layout = tuple(
+        (dt, tuple(idxs)) for dt, idxs in sum_groups.items()
+    )
+    return sum_layout, tuple(live_keys), tuple(mm_idx)
+
+
+def _seg_part1(
     valid,
     key_cols: list,
     key_nulls: list,
     val_cols: list,
     val_nulls: list,
+    perm,
     ops: tuple,
     capacity: int,
-) -> GroupAggResult:
-    """Jit-compiled finisher: everything after the sort passes. Gathers are
-    cheap to compile; there is no sort in here."""
+    clustered: bool,
+    sum_layout: tuple,
+    live_layout: tuple,
+    mm_idx: tuple,
+):
+    """Program 1: segment ids + boundary positions + running sums.
+
+    ``clustered=False``: inputs are the SORTED (gathered) operands — valid
+    rows compacted to the front, groups adjacent; ``perm`` is the sort
+    permutation, used only to report ``input_was_sorted`` (a strictly
+    increasing live prefix of a STABLE sort's permutation means the input
+    was already clustered — the learning signal for the presorted path).
+
+    ``clustered=True``: inputs are in ORIGINAL order, speculated to be
+    grouped-adjacent among live rows (invalid rows anywhere); boundaries
+    compare against the previous LIVE row via a forward-fill, and
+    ``sorted_ok`` reports whether the speculation actually held.
+    """
     n = valid.shape[0]
-    # ONE stacked random-access pass moves every operand into sorted order
-    # (a TPU gather's cost is per row, not per byte of row payload).
-    nk, nv = len(key_cols), len(val_cols)
-    gathered, opt = take_many_split(
-        [valid] + list(key_cols) + list(val_cols),
-        list(key_nulls) + list(val_nulls),
-        perm,
-    )
-    s_valid = gathered[0]
-    sorted_keys = gathered[1 : 1 + nk]
-    sorted_vals = gathered[1 + nk : 1 + nk + nv]
-    sorted_key_nulls = opt[:nk]
-    sorted_val_nulls = opt[nk:]
+    iota = jnp.arange(n, dtype=jnp.int32)
 
-    # Segment boundaries over the SORTED key operands. Null keys compare by
-    # (null flag, zeroed value); float keys: NaN==NaN is "same" (SQL groups
-    # NaNs together) and -0.0==+0.0 is "same".
-    changed = jnp.zeros(n, dtype=bool).at[0].set(True)
-
-    def op_same(a, b):
-        same = a == b
-        if jnp.issubdtype(a.dtype, jnp.floating):
-            same = same | (jnp.isnan(a) & jnp.isnan(b))
-        return same
-
-    for s_kc, s_kn in zip(sorted_keys, sorted_key_nulls):
-        if s_kn is not None:
-            changed = changed | jnp.concatenate(
-                [jnp.ones(1, dtype=bool), s_kn[1:] != s_kn[:-1]]
-            )
-            zc = jnp.where(s_kn, jnp.zeros_like(s_kc), s_kc)
+    # (null flag, zeroed value) per key: the group-identity tuple.
+    zkeys, kflags = [], []
+    for kc, kn in zip(key_cols, key_nulls):
+        if kn is not None:
+            zkeys.append(jnp.where(kn, jnp.zeros_like(kc), kc))
+            kflags.append(kn)
         else:
-            zc = s_kc
-        changed = changed | jnp.concatenate(
-            [jnp.ones(1, dtype=bool), ~op_same(zc[1:], zc[:-1])]
-        )
-    seg_id = jnp.cumsum(changed.astype(jnp.int32)) - 1
-    n_groups = jnp.max(jnp.where(s_valid, seg_id, -1)) + 1
-    overflow = n_groups > capacity
+            zkeys.append(kc)
+            kflags.append(None)
 
-    # Scatter original key values (one write per row; all rows of a segment
-    # carry equal keys). Invalid rows scatter to index `capacity` -> dropped.
-    # A TPU scatter's cost is dominated by the per-row index traversal, not
-    # the payload width, so same-dtype columns are STACKED into one (n, M)
-    # operand per (reduction, dtype) — measured 1.19s -> 0.19s for 8 f64
-    # sums over 1M rows vs one scatter per column.
-    scatter_id = jnp.where(s_valid, seg_id, capacity)
-    out_keys = _stacked_scatter_set(
-        scatter_id, capacity, sorted_keys
+    sorted_ok = None
+    input_was_sorted = None
+    if clustered:
+        parts = tuple(zkeys) + tuple(f for f in kflags if f is not None)
+        pv, pf = _ffill_tuple(parts, valid)
+        prev_z = pv[: len(zkeys)]
+        prev_f_it = iter(pv[len(zkeys):])
+        prev_flags = [
+            next(prev_f_it) if f is not None else None for f in kflags
+        ]
+        # shift to STRICTLY-previous live row
+        prev_z = [
+            jnp.concatenate([jnp.zeros(1, z.dtype), z[:-1]]) for z in prev_z
+        ]
+        prev_flags = [
+            None
+            if f is None
+            else jnp.concatenate([jnp.zeros(1, bool), f[:-1]])
+            for f in prev_flags
+        ]
+        prev_live = jnp.concatenate([jnp.zeros(1, bool), pf[:-1]])
+        same = jnp.ones(n, dtype=bool)
+        greater = jnp.zeros(n, dtype=bool)
+        eq_chain = jnp.ones(n, dtype=bool)
+        for z, pz, f, pflag in zip(zkeys, prev_z, kflags, prev_flags):
+            if f is not None:
+                # null flags sort nulls last (False < True): prev is
+                # "greater" when prev is null and current is not
+                pair_same = (f == pflag) & _same_val(z, pz)
+                pair_gt = (pflag & ~f) | ((f == pflag) & _gt_val(pz, z))
+            else:
+                pair_same = _same_val(z, pz)
+                pair_gt = _gt_val(pz, z)
+            same = same & pair_same
+            greater = greater | (eq_chain & pair_gt)
+            eq_chain = eq_chain & pair_same
+        changed = valid & (~prev_live | ~same)
+        sorted_ok = ~jnp.any(valid & prev_live & greater)
+        row_valid = valid
+    else:
+        changed = jnp.zeros(n, dtype=bool).at[0].set(True)
+        for z, f in zip(zkeys, kflags):
+            if f is not None:
+                changed = changed | jnp.concatenate(
+                    [jnp.ones(1, dtype=bool), f[1:] != f[:-1]]
+                )
+            changed = changed | jnp.concatenate(
+                [jnp.ones(1, dtype=bool), ~_same_val(z[1:], z[:-1])]
+            )
+        row_valid = valid
+        changed = changed & row_valid
+        if perm is not None:
+            n_live = jnp.sum(row_valid.astype(jnp.int32))
+            input_was_sorted = jnp.all(
+                (perm[1:] > perm[:-1]) | (iota[1:] >= n_live)
+            )
+
+    seg = jnp.cumsum(changed.astype(jnp.int32)) - 1
+    n_groups = jnp.sum(changed.astype(jnp.int32))
+    overflow = n_groups > capacity
+    # dead rows (and overflow segments) scatter out of bounds -> dropped.
+    # The sorted-indices hint is only legal when dead rows can't interrupt
+    # the monotonic run: true post-sort (dead rows are all at the tail),
+    # FALSE on the clustered path (dead rows anywhere -> their `capacity`
+    # sentinel breaks monotonicity, and a wrong hint is UB on TPU).
+    sid = jnp.where(row_valid, seg, capacity)
+    hint = not clustered
+
+    pe = jnp.full(capacity, -1, jnp.int32).at[sid].max(
+        iota, mode="drop", indices_are_sorted=hint
     )
-    kn_present = [
-        i for i, kn in enumerate(sorted_key_nulls) if kn is not None
-    ]
-    kn_out = _stacked_scatter_set(
-        scatter_id, capacity, [sorted_key_nulls[i] for i in kn_present]
+    ps = jnp.full(capacity, n, jnp.int32).at[sid].min(
+        iota, mode="drop", indices_are_sorted=hint
     )
-    out_key_nulls: list = [None] * len(key_cols)
-    for i, col in zip(kn_present, kn_out):
-        out_key_nulls[i] = col
 
     lives = [
-        s_valid if svn is None else (s_valid & ~svn)
-        for svn in sorted_val_nulls
+        row_valid if vn is None else (row_valid & ~vn) for vn in val_nulls
     ]
-    out_vals, out_val_nulls = _stacked_reduce(
-        scatter_id, capacity, sorted_vals, lives, ops
+    # non-null running counts, one stacked (n, M) int32 cumsum; distinct
+    # live masks only (no-null columns all share the plain valid mask).
+    # A key-only aggregate (DISTINCT dedup) has no value columns: emit a
+    # 1-wide dummy so downstream shapes stay static.
+    cnt_stack = jnp.stack(
+        [
+            (row_valid if k == -1 else lives[k]).astype(jnp.int32)
+            for k in live_layout
+        ]
+        or [jnp.zeros(n, jnp.int32)],
+        axis=1,
+    )
+    cnt_cs = jnp.cumsum(cnt_stack, axis=0)
+
+    # running sums, stacked per accumulator dtype
+    sum_cs = []
+    for dt, idxs in sum_layout:
+        acc_t = jnp.dtype(dt)
+        contribs = [
+            jnp.where(
+                lives[i], val_cols[i], jnp.zeros_like(val_cols[i])
+            ).astype(acc_t)
+            for i in idxs
+        ]
+        sum_cs.append(jnp.cumsum(jnp.stack(contribs, axis=1), axis=0))
+    mm_vals = []
+    for i in mm_idx:
+        vc, live = val_cols[i], lives[i]
+        if ops[i] == AggOp.MIN:
+            masked = jnp.where(live, vc, _max_ident(vc.dtype))
+            mm_vals.append(
+                jnp.full(capacity, _max_ident(vc.dtype), vc.dtype)
+                .at[sid].min(masked, mode="drop", indices_are_sorted=hint)
+            )
+        else:
+            masked = jnp.where(live, vc, _min_ident(vc.dtype))
+            mm_vals.append(
+                jnp.full(capacity, _min_ident(vc.dtype), vc.dtype)
+                .at[sid].max(masked, mode="drop", indices_are_sorted=hint)
+            )
+    return (
+        n_groups.astype(jnp.int32),
+        overflow,
+        input_was_sorted,
+        sorted_ok,
+        pe,
+        ps,
+        cnt_cs,
+        sum_cs,
+        mm_vals,
     )
 
-    out_valid = jnp.arange(capacity, dtype=jnp.int32) < n_groups
+
+def _seg_part2(
+    n_groups,
+    pe,
+    ps,
+    cnt_cs,
+    sum_cs: list,
+    mm_vals: list,
+    key_cols: list,
+    key_nulls: list,
+    ops: tuple,
+    capacity: int,
+    sum_layout: tuple,
+    live_layout: tuple,
+    mm_idx: tuple,
+):
+    """Program 2: boundary gathers -> per-group outputs."""
+    n = cnt_cs.shape[0]
+    slot = jnp.arange(capacity, dtype=jnp.int32)
+    out_valid = slot < n_groups
+    pe_c = jnp.clip(pe, 0, n - 1)
+    ps_c = jnp.clip(ps, 0, n - 1)
+    ps_prev = jnp.clip(ps_c - 1, 0, n - 1)
+    has_prev = (ps > 0) & out_valid
+
+    def seg_totals(cs2d):
+        # two row-gathers per stacked cumsum (end rows, pre-start rows)
+        ends = cs2d[pe_c]
+        pre = jnp.where(has_prev[:, None], cs2d[ps_prev], 0)
+        return ends - pre
+
+    cnt_tot = seg_totals(cnt_cs)
+    live_slot = {k: j for j, k in enumerate(live_layout)}
+    sum_slot: dict[int, tuple[int, int]] = {}
+    sum_tots = [seg_totals(cs2d) for cs2d in sum_cs]
+    for gi, (dt, idxs) in enumerate(sum_layout):
+        for j, i in enumerate(idxs):
+            sum_slot[i] = (gi, j)
+    mm_map = dict(zip(mm_idx, mm_vals))
+
+    m = len(ops)
+    out_vals: list = [None] * m
+    out_val_nulls: list = [None] * m
+    for i, op in enumerate(ops):
+        lk = i if i in live_slot else -1
+        nonnull = cnt_tot[:, live_slot[lk]].astype(jnp.int64)
+        if op == AggOp.COUNT:
+            out_vals[i] = jnp.where(out_valid, nonnull, 0)
+            continue
+        out_val_nulls[i] = nonnull == 0
+        if op == AggOp.SUM:
+            gi, j = sum_slot[i]
+            out_vals[i] = sum_tots[gi][:, j]
+        else:
+            out_vals[i] = mm_map[i]
+
+    # group keys: the first row of each segment is LIVE and carries the
+    # group's actual key values — one stacked gather at start positions
+    key_arrs = list(key_cols) + [kn for kn in key_nulls if kn is not None]
+    if key_arrs:
+        gathered, _ = take_many_split(key_arrs, [], ps_c)
+    else:
+        gathered = []
+    out_keys = [
+        jnp.where(out_valid, k, jnp.zeros_like(k))
+        for k in gathered[: len(key_cols)]
+    ]
+    kn_it = iter(gathered[len(key_cols):])
+    out_key_nulls = [
+        (next(kn_it) & out_valid) if kn is not None else None
+        for kn in key_nulls
+    ]
     return GroupAggResult(
         keys=out_keys,
         key_nulls=out_key_nulls,
         values=out_vals,
         value_nulls=out_val_nulls,
         valid=out_valid,
-        n_groups=n_groups.astype(jnp.int32),
-        overflow=overflow,
+        n_groups=n_groups,
+        overflow=jnp.zeros((), bool),  # carried by part1's flag
     )
 
 
-_agg_finish_jit = jax.jit(_agg_finish, static_argnames=("ops", "capacity"))
+_seg_part1_jit = jax.jit(
+    _seg_part1,
+    static_argnames=(
+        "ops", "capacity", "clustered", "sum_layout", "live_layout",
+        "mm_idx",
+    ),
+)
+_seg_part2_jit = jax.jit(
+    _seg_part2,
+    static_argnames=("ops", "capacity", "sum_layout", "live_layout",
+                     "mm_idx"),
+)
+
+
+def _segment_aggregate(
+    valid,
+    key_cols: list,
+    key_nulls: list,
+    val_cols: list,
+    val_nulls: list,
+    perm,
+    ops: tuple,
+    capacity: int,
+    clustered: bool,
+) -> GroupAggResult:
+    """Host-composed two-program segment reduction (see module comment)."""
+    sum_layout, live_layout, mm_idx = _seg_layouts(
+        tuple(str(v.dtype) for v in val_cols),
+        tuple(vn is not None for vn in val_nulls),
+        tuple(ops),
+    )
+    (
+        n_groups, overflow, input_was_sorted, sorted_ok, pe, ps,
+        cnt_cs, sum_cs, mm_vals,
+    ) = _seg_part1_jit(
+        valid, list(key_cols), list(key_nulls), list(val_cols),
+        list(val_nulls), perm, tuple(ops), capacity, clustered,
+        sum_layout, live_layout, mm_idx,
+    )
+    res = _seg_part2_jit(
+        n_groups, pe, ps, cnt_cs, list(sum_cs), list(mm_vals),
+        list(key_cols), list(key_nulls), tuple(ops), capacity,
+        sum_layout, live_layout, mm_idx,
+    )
+    res.overflow = overflow
+    res.input_was_sorted = input_was_sorted
+    res.sorted_ok = sorted_ok
+    return res
 
 
 def group_aggregate(
@@ -371,13 +678,28 @@ def group_aggregate(
     val_nulls: list[jnp.ndarray | None],
     ops: list[AggOp],
     capacity: int,
+    presorted: bool = False,
 ) -> GroupAggResult:
     """Aggregate ``val_cols[i]`` with ``ops[i]`` grouped by ``key_cols``.
 
     All inputs share one row axis; ``valid`` masks live rows. Outputs have
     static length ``capacity`` with a validity mask over actual groups.
-    Host-composes cached sort passes, then one jitted finisher.
+
+    ``presorted=False``: host-composes cached sort passes + the stacked
+    gather, then the two-program segment finisher; the result's
+    ``input_was_sorted`` device flag reports (for free, off the stable
+    sort's permutation) whether the sort was actually needed.
+
+    ``presorted=True``: skips the sort AND the gather entirely — rows are
+    speculated to be grouped-adjacent among live rows (clustered input,
+    e.g. TPC-H lineitem grouped by l_orderkey); the result's ``sorted_ok``
+    flag must be validated via the deferred-speculation protocol.
     """
+    if presorted:
+        return _segment_aggregate(
+            valid, key_cols, key_nulls, val_cols, val_nulls, None,
+            tuple(ops), capacity, clustered=True,
+        )
     cap = valid.shape[0]
     # SQL GROUP BY: NULL is its own group. Null keys get a flag pass and a
     # zeroed value so all-null rows compare equal.
@@ -393,9 +715,19 @@ def group_aggregate(
         else:
             passes.append((kc, False))
     perm = multi_key_perm(passes)
-    return _agg_finish_jit(
-        perm, valid, list(key_cols), list(key_nulls), list(val_cols),
-        list(val_nulls), tuple(ops), capacity,
+    from ballista_tpu.ops.perm import take_batch
+
+    s_cols, s_nulls, s_valid = take_batch(
+        list(key_cols) + list(val_cols),
+        list(key_nulls) + list(val_nulls),
+        valid,
+        perm,
+    )
+    nk = len(key_cols)
+    return _segment_aggregate(
+        s_valid, list(s_cols[:nk]), list(s_nulls[:nk]),
+        list(s_cols[nk:]), list(s_nulls[nk:]), perm, tuple(ops),
+        capacity, clustered=False,
     )
 
 

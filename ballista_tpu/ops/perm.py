@@ -103,10 +103,11 @@ def take_many_split(
 
 
 @functools.lru_cache(maxsize=None)
-def _take_batch_program(sig: tuple, nulls_sig: tuple, cap: int):
+def _take_batch_program(sig: tuple, nulls_sig: tuple):
     """One jitted program gathering a whole column set (+ null masks +
     valid) by a permutation, stacked by dtype — the sort/shuffle data
-    movement as ONE dispatch instead of one per column."""
+    movement as ONE dispatch instead of one per column. (jax.jit retraces
+    per shape on its own, so capacity is deliberately NOT in the key.)"""
 
     def f(cols, nulls, valid, perm):
         gathered, out_nulls = take_many_split(
@@ -121,7 +122,7 @@ def take_batch(cols: list, nulls: list, valid, perm):
     """Gather columns + null masks + valid by ``perm`` in one dispatch."""
     sig = tuple(str(c.dtype) for c in cols)
     nulls_sig = tuple(m is not None for m in nulls)
-    prog = _take_batch_program(sig, nulls_sig, valid.shape[0])
+    prog = _take_batch_program(sig, nulls_sig)
     return prog(tuple(cols), tuple(nulls), valid, perm)
 
 

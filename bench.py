@@ -170,8 +170,10 @@ def main() -> None:
             if not k.startswith(("PALLAS_AXON", "AXON"))
         }
         env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": str(HERE)})
-        # CPU baseline is best-effort: a failure degrades vs_baseline to 0
-        cpu_run = _run_child(env, max(1, ITERS - 2), 3600, "cpu")
+        # CPU baseline is best-effort: a failure degrades vs_baseline to 0.
+        # Same warm-iteration count as the device so best-of-N variance
+        # treats both backends identically.
+        cpu_run = _run_child(env, ITERS, 3600, "cpu")
 
     detail = {"device": device_run, "cpu": cpu_run}
     (HERE / "BENCH_DETAIL.json").write_text(json.dumps(detail, indent=2))

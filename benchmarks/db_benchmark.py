@@ -5,9 +5,8 @@ ref benchmarks/db-benchmark/{groupby-datafusion.py,join-datafusion.py} —
 the standard G1 groupby questions and the join benchmark, run over the
 engine with synthetic data matching the h2o generator's shape (no egress:
 the official x.csv inputs aren't downloadable here; pass --data to use a
-real G1 file). Questions the engine doesn't support yet (percentile,
-stddev, window row_number, corr) are skipped with a note, mirroring how
-the reference comments out unsupported questions.
+real G1 file). Questions the engine doesn't support yet are skipped with
+a note, mirroring how the reference comments out unsupported questions.
 
 Usage: python benchmarks/db_benchmark.py [--n 1e6] [--k 100] [--iterations 2]
 """

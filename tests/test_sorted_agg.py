@@ -1,0 +1,254 @@
+"""Clustered-input (presorted) aggregation: the segment-reduction kernel
+and its speculation protocol.
+
+The TPU kernel (ops/aggregate.py `_segment_aggregate`) replaces scatter
+reductions with cumsum + boundary gathers once rows are grouped-adjacent;
+`presorted=True` additionally skips the sort and gather. The exec layer
+learns clusteredness off the stable sort's permutation and validates the
+fast path with a deferred flag (ref behavior: DataFusion's ordered-input
+aggregation; the wire shape is the same HashAggregateExecNode,
+ballista.proto:446-455 — clustering is purely an execution-time detail).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from ballista_tpu.ops.aggregate import AggOp, group_aggregate
+
+
+def _oracle(keys, vals, valid, op):
+    df = pd.DataFrame({"k": keys, "v": vals, "ok": valid})
+    df = df[df.ok]
+    if op == "sum":
+        return df.groupby("k").v.sum()
+    if op == "min":
+        return df.groupby("k").v.min()
+    if op == "max":
+        return df.groupby("k").v.max()
+    return df.groupby("k").v.count()
+
+
+def _result_frame(res, n_vals=1):
+    keys = np.asarray(res.keys[0])
+    valid = np.asarray(res.valid)
+    out = {}
+    for g, v in zip(
+        keys[valid], np.asarray(res.values[0])[valid]
+    ):
+        out[g] = v
+    return out
+
+
+@pytest.mark.parametrize("presorted", [False, True])
+def test_clustered_sum_count_min_max(presorted):
+    rng = np.random.default_rng(7)
+    n = 4096
+    keys = np.sort(rng.integers(0, 300, n)).astype(np.int64)
+    vals = rng.random(n) * 100
+    ivals = rng.integers(-50, 50, n).astype(np.int64)
+    valid = rng.random(n) < 0.6  # interspersed invalid rows
+    res = group_aggregate(
+        [jnp.asarray(keys)],
+        [None],
+        jnp.asarray(valid),
+        [jnp.asarray(vals), jnp.asarray(ivals), jnp.asarray(vals),
+         jnp.asarray(ivals)],
+        [None, None, None, None],
+        [AggOp.SUM, AggOp.SUM, AggOp.MIN, AggOp.MAX],
+        1024,
+        presorted=presorted,
+    )
+    if presorted:
+        assert bool(res.sorted_ok)
+    else:
+        assert bool(res.input_was_sorted)
+    ok = np.asarray(res.valid)
+    got_keys = np.asarray(res.keys[0])[ok]
+    o_sum = _oracle(keys, vals, valid, "sum")
+    assert sorted(got_keys) == sorted(o_sum.index)
+    order = {g: i for i, g in enumerate(got_keys)}
+    gv = np.asarray(res.values[0])[ok]
+    np.testing.assert_allclose(
+        [gv[order[g]] for g in o_sum.index], o_sum.values, rtol=1e-7
+    )
+    o_isum = _oracle(keys, ivals, valid, "sum")
+    giv = np.asarray(res.values[1])[ok]
+    assert [giv[order[g]] for g in o_isum.index] == list(o_isum.values)
+    o_min = _oracle(keys, vals, valid, "min")
+    gmn = np.asarray(res.values[2])[ok]
+    np.testing.assert_allclose(
+        [gmn[order[g]] for g in o_min.index], o_min.values
+    )
+    o_max = _oracle(keys, ivals, valid, "max")
+    gmx = np.asarray(res.values[3])[ok]
+    assert [gmx[order[g]] for g in o_max.index] == list(o_max.values)
+
+
+def test_presorted_flags_unsorted_input():
+    """sorted_ok must come back False when the speculation is wrong."""
+    keys = jnp.asarray(np.array([5, 1, 5, 1, 2, 2], dtype=np.int64))
+    vals = jnp.asarray(np.ones(6))
+    valid = jnp.asarray(np.ones(6, bool))
+    res = group_aggregate(
+        [keys], [None], valid, [vals], [None], [AggOp.SUM], 8,
+        presorted=True,
+    )
+    assert not bool(res.sorted_ok)
+    # and the sort path reports the input as NOT clustered
+    res2 = group_aggregate(
+        [keys], [None], valid, [vals], [None], [AggOp.SUM], 8,
+    )
+    assert not bool(res2.input_was_sorted)
+    ok = np.asarray(res2.valid)
+    assert sorted(np.asarray(res2.keys[0])[ok]) == [1, 2, 5]
+
+
+def test_clustered_null_keys_and_values():
+    """NULL keys form their own group; NULL values are skipped; an
+    all-NULL group yields NULL sum (SQL) in both paths."""
+    keys = np.array([1, 1, 2, 2, 3, 3], dtype=np.int64)
+    knull = np.array([False, False, False, False, True, True])
+    vals = np.array([1.0, 2.0, 9.0, 9.0, 5.0, 6.0])
+    vnull = np.array([False, False, True, True, False, False])
+    valid = np.ones(6, bool)
+    for presorted in (False, True):
+        res = group_aggregate(
+            [jnp.asarray(keys)],
+            [jnp.asarray(knull)],
+            jnp.asarray(valid),
+            [jnp.asarray(vals)],
+            [jnp.asarray(vnull)],
+            [AggOp.SUM],
+            8,
+            presorted=presorted,
+        )
+        ok = np.asarray(res.valid)
+        assert int(ok.sum()) == 3
+        got = {}
+        kn = np.asarray(res.key_nulls[0])
+        for i in np.nonzero(ok)[0]:
+            k = "NULL" if kn[i] else int(np.asarray(res.keys[0])[i])
+            got[k] = (
+                None
+                if np.asarray(res.value_nulls[0])[i]
+                else float(np.asarray(res.values[0])[i])
+            )
+        assert got[1] == 3.0
+        assert got[2] is None  # all values NULL -> SUM is NULL
+        assert got["NULL"] == 11.0
+
+
+def test_presorted_overflow_reports_group_count():
+    keys = jnp.asarray(np.arange(64, dtype=np.int64))
+    res = group_aggregate(
+        [keys], [None], jnp.asarray(np.ones(64, bool)),
+        [jnp.asarray(np.ones(64))], [None], [AggOp.SUM], 16,
+        presorted=True,
+    )
+    assert bool(res.overflow)
+    assert int(res.n_groups) == 64
+
+
+def test_engine_learns_clustered_path(tmp_path):
+    """End-to-end: a clustered GROUP BY learns the fast path on run 1,
+    uses it (validated) on run 2, and both runs agree with the oracle."""
+    import pyarrow as pa
+
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.exec.context import TpuContext
+
+    rng = np.random.default_rng(3)
+    n = 5000
+    k = np.sort(rng.integers(0, 800, n))
+    v = rng.random(n) * 10
+    t = pa.table({"k": pa.array(k, pa.int64()), "v": pa.array(v)})
+    ctx = TpuContext(BallistaConfig())
+    ctx.register_table("t", t)
+    sql = "select k, sum(v) as s, count(*) as c from t group by k"
+    r1 = ctx.sql(sql).collect().to_pandas().set_index("k").sort_index()
+    # the clustered flag must now be cached for the partial-agg site
+    learned = [
+        key for key in ctx._plan_cache if key[0] == "agg_sorted"
+    ]
+    assert learned, "no clusteredness learned"
+    assert any(ctx._plan_cache[key] is True for key in learned)
+    r2 = ctx.sql(sql).collect().to_pandas().set_index("k").sort_index()
+    oracle = (
+        pd.DataFrame({"k": k, "v": v})
+        .groupby("k")
+        .agg(s=("v", "sum"), c=("v", "count"))
+    )
+    for r in (r1, r2):
+        np.testing.assert_allclose(r["s"], oracle["s"], rtol=1e-7)
+        assert list(r["c"]) == list(oracle["c"])
+
+
+def test_state_slice_respects_masked_repartition():
+    """A final aggregate fed by an in-place-masking hash repartition gets
+    states whose live groups are NOT prefix-compacted; the learned
+    state-slice must detect that (prefix flag) and never drop groups.
+    Two runs: learn, then the run that would slice if it (wrongly) could."""
+    import pyarrow as pa
+
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.exec.context import TpuContext
+
+    rng = np.random.default_rng(11)
+    n = 20_000
+    k = rng.integers(0, 5000, n)  # many groups -> masked repartition states
+    v = rng.random(n)
+    t = pa.table({"k": pa.array(k, pa.int64()), "v": pa.array(v)})
+    ctx = TpuContext(
+        BallistaConfig().with_setting("ballista.shuffle.partitions", "4")
+    )
+    ctx.register_table("t", t)
+    sql = "select k, sum(v) as s, count(*) as c from t group by k"
+    oracle = (
+        pd.DataFrame({"k": k, "v": v})
+        .groupby("k")
+        .agg(s=("v", "sum"), c=("v", "count"))
+    )
+    for run in (1, 2):
+        r = (
+            ctx.sql(sql).collect().to_pandas().set_index("k").sort_index()
+        )
+        assert len(r) == len(oracle), f"run {run} dropped groups"
+        np.testing.assert_allclose(r["s"], oracle["s"], rtol=1e-7)
+        assert list(r["c"]) == list(oracle["c"])
+
+
+def test_engine_speculation_miss_recovers(tmp_path):
+    """Poison the cache with a wrong 'clustered' claim: the run must
+    detect it (SpeculationMiss -> invalidate -> retry) and still return
+    correct results."""
+    import pyarrow as pa
+
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.exec.context import TpuContext
+
+    rng = np.random.default_rng(4)
+    n = 3000
+    k = rng.integers(0, 500, n)  # NOT clustered
+    v = rng.random(n)
+    t = pa.table({"k": pa.array(k, pa.int64()), "v": pa.array(v)})
+    ctx = TpuContext(BallistaConfig())
+    ctx.register_table("t", t)
+    sql = "select k, sum(v) as s from t group by k"
+    ctx.sql(sql).collect()  # learn (False expected)
+    # force-poison every agg_sorted entry to True
+    poisoned = 0
+    for key in list(ctx._plan_cache):
+        if key[0] == "agg_sorted":
+            ctx._plan_cache[key] = True
+            poisoned += 1
+    assert poisoned
+    out = ctx.sql(sql).collect().to_pandas().set_index("k").sort_index()
+    oracle = pd.DataFrame({"k": k, "v": v}).groupby("k").v.sum()
+    np.testing.assert_allclose(out["s"], oracle.values, rtol=1e-7)
+    # the poisoned entries were invalidated back to the truth
+    for key in list(ctx._plan_cache):
+        if key[0] == "agg_sorted":
+            assert ctx._plan_cache[key] is not True
