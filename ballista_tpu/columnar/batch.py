@@ -185,6 +185,21 @@ class DeviceBatch:
             dictionaries=dict(self.dictionaries),
         )
 
+    def head(self, capacity: int) -> "DeviceBatch":
+        """Slice every array down to the first ``capacity`` rows (a pure
+        device slice — the caller must know live rows fit the prefix)."""
+        if capacity >= self.capacity:
+            return self
+        return DeviceBatch(
+            schema=self.schema,
+            columns=tuple(c[:capacity] for c in self.columns),
+            valid=self.valid[:capacity],
+            nulls=tuple(
+                None if m is None else m[:capacity] for m in self.nulls
+            ),
+            dictionaries=dict(self.dictionaries),
+        )
+
     # -- host materialization ------------------------------------------------
     # Above this many bytes, fetching the full padded capacity costs more
     # than an extra round trip + a device-side compaction (tunnelled-TPU
@@ -217,19 +232,10 @@ class DeviceBatch:
             if n * 4 <= self.capacity:
                 from ballista_tpu.ops.compact import compact
 
-                b = compact(self)
                 m = 8
                 while m < n:
                     m <<= 1
-                b = DeviceBatch(
-                    schema=b.schema,
-                    columns=tuple(c[:m] for c in b.columns),
-                    valid=b.valid[:m],
-                    nulls=tuple(
-                        None if mm is None else mm[:m] for mm in b.nulls
-                    ),
-                    dictionaries=dict(b.dictionaries),
-                )
+                b = compact(self).head(m)
         fetched = fetch_arrays(
             [b.valid, *b.columns, *[m for m in b.nulls if m is not None]]
         )

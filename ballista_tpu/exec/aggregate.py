@@ -467,7 +467,13 @@ class HashAggregateExec(ExecutionPlan):
             # invalidates + retries, the shrink/join-strategy protocol).
             cache = ctx.plan_cache if ctx is not None else None
             skey = (
-                ("agg_sorted", site, from_state, batch.capacity)
+                (
+                    "agg_sorted",
+                    getattr(ctx, "job_id", ""),
+                    site,
+                    from_state,
+                    batch.capacity,
+                )
                 if (cache is not None and site is not None)
                 else None
             )
@@ -708,7 +714,12 @@ class HashAggregateExec(ExecutionPlan):
         from ballista_tpu.columnar.batch import round_capacity
 
         cache = ctx.plan_cache
-        key = ("agg_state_cap", site, partition)
+        # job-scoped like join _strategy_key: one executor serves many jobs
+        # whose plans can collide structurally; a shared entry would make
+        # alternating jobs re-poison each other's learned capacities and
+        # pay a SpeculationMiss re-run per query
+        job = getattr(ctx, "job_id", "")
+        key = ("agg_state_cap", job, site, partition)
         # Slicing assumes live groups occupy a PREFIX. True for partial
         # outputs (valid = iota < n_groups) but NOT for states that came
         # through an in-place-masking hash repartition, whose live rows are
@@ -716,7 +727,7 @@ class HashAggregateExec(ExecutionPlan):
         # is learned as its own flag (AND-ed across states), and every
         # slice is additionally validated by "no live row beyond the
         # slice", which catches layout drift exactly.
-        pkey = ("agg_state_prefix", site, partition)
+        pkey = ("agg_state_prefix", job, site, partition)
         learned = cache.get(key)
         prefix_ok = cache.get(pkey)
         if learned is None or prefix_ok is None:
@@ -740,18 +751,7 @@ class HashAggregateExec(ExecutionPlan):
                 "beyond the slice)",
                 [key, pkey],
             )
-            out.append(
-                DeviceBatch(
-                    schema=st.schema,
-                    columns=tuple(c[:slice_cap] for c in st.columns),
-                    valid=st.valid[:slice_cap],
-                    nulls=tuple(
-                        None if m is None else m[:slice_cap]
-                        for m in st.nulls
-                    ),
-                    dictionaries=dict(st.dictionaries),
-                )
-            )
+            out.append(st.head(slice_cap))
         return out
 
     def _finalize(self, state: DeviceBatch, n_groups: int) -> DeviceBatch:

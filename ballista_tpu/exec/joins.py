@@ -64,9 +64,11 @@ def _collect_partition(
 # would re-inline the sorts into one slow-compiling program — don't); the
 # probe is a single fast-compiling program per shape.
 @functools.lru_cache(maxsize=None)
-def _jit_probe(probe_keys: tuple, kind: JoinSide):
+def _jit_probe(probe_keys: tuple, kind: JoinSide, contiguous: bool = False):
     return jax.jit(
-        lambda bt, pb: probe_side(bt, pb, list(probe_keys), kind)
+        lambda bt, pb: probe_side(
+            bt, pb, list(probe_keys), kind, contiguous=contiguous
+        )
     )
 
 
@@ -314,7 +316,7 @@ class HashJoinExec(ExecutionPlan):
                 cache[fp] = flags
         self._decide_flags = flags
         self._decide_from_cache = from_cache
-        bt_dups, bt_ovf = flags
+        bt_dups, bt_ovf = flags[0], flags[1]
         if bt_dups or bt_ovf:
             # Right side can't serve as a unique build (dups, or a hash-mode
             # collision run past the probe window). Deterministic across
@@ -336,7 +338,7 @@ class HashJoinExec(ExecutionPlan):
                 lflags = lbt.flags()
                 if cache is not None:
                     cache[lfp] = lflags
-            lbt_dups, lbt_ovf = lflags
+            lbt_dups, lbt_ovf = lflags[0], lflags[1]
             if not lbt_dups and not lbt_ovf:
                 # flip: build (unique) left, probe the collected right
                 if l_from_cache:
@@ -346,8 +348,11 @@ class HashJoinExec(ExecutionPlan):
                         "no longer unique)",
                         [lfp],
                     )
+                contig = self._contig_probe(
+                    lbt, lflags, l_from_cache, ctx, lfp
+                )
                 joined = self._probe_with_filter(
-                    lbt, rb, right_keys, JoinSide.INNER
+                    lbt, rb, right_keys, JoinSide.INNER, contig
                 )
                 out = self._restore_column_order(
                     joined, rb, lbt.batch, build_is_right=False
@@ -437,14 +442,27 @@ class HashJoinExec(ExecutionPlan):
             yield first
             yield from iter_first
 
+        # contiguity applies only while bt matches the build the flags
+        # describe: a dictionary-unification rebuild REMAps key codes (a
+        # contiguous code range can gain holes), and _validate only covers
+        # dups/overflow — so a rebuilt build conservatively drops the
+        # range-probe fast path instead of trusting stale flags.
+        contig = (
+            self._contig_probe(bt, flags, from_cache, ctx, fp)
+            if bb is right_batch
+            else False
+        )
         for b in _rest():
             bb2, pb = self._unify_key_dicts(base, b, right_keys, left_keys)
             if bb2 is not base:
                 with self.metrics.time("build_time"):
                     bt = build_side(bb2, right_keys)
                 _validate(bt)
+                contig = False
                 base = bb2
-            joined = self._probe_with_filter(bt, pb, left_keys, JoinSide.INNER)
+            joined = self._probe_with_filter(
+                bt, pb, left_keys, JoinSide.INNER, contig
+            )
             out = self._restore_column_order(joined, pb, bt.batch, True)
             self.metrics.add("output_batches")
             yield out
@@ -483,7 +501,7 @@ class HashJoinExec(ExecutionPlan):
         cache = ctx.plan_cache if ctx is not None else None
         cached = cache.get(fp) if (cache is not None and fp) else None
         if cached is not None:
-            dups, _overflow = cached
+            dups, _overflow = cached[0], cached[1]
             if not dups:
                 ctx.defer_speculation(
                     bt.spec_flag(),
@@ -491,7 +509,10 @@ class HashJoinExec(ExecutionPlan):
                     "longer unique)",
                     [fp],
                 )
-                return self._probe_with_filter(bt, probe, probe_keys, kind)
+                contig = self._contig_probe(bt, cached, True, ctx, fp)
+                return self._probe_with_filter(
+                    bt, probe, probe_keys, kind, contig
+                )
             # expansion also handles a unique build; only collision
             # overflow invalidates it
             ctx.defer_speculation(
@@ -503,16 +524,20 @@ class HashJoinExec(ExecutionPlan):
             return self._expand_with_filter(
                 bt, probe, probe_keys, kind, ctx, fp, partition
             )
-        dups, overflow = bt.flags()
+        flags = bt.flags()
+        dups, overflow = flags[0], flags[1]
         if cache is not None and fp and not overflow:
             # never cache an overflowing build: the overflow is a hard
             # deterministic error below, and a cached entry would prepend a
             # wasted speculative run to every future occurrence
-            cache[fp] = (dups, overflow)
+            cache[fp] = flags
         if overflow:
             bt.check_overflow()
         if not dups:
-            return self._probe_with_filter(bt, probe, probe_keys, kind)
+            contig = self._contig_probe(bt, flags, False, ctx, fp)
+            return self._probe_with_filter(
+                bt, probe, probe_keys, kind, contig
+            )
         return self._expand_with_filter(
             bt, probe, probe_keys, kind, ctx, fp, partition
         )
@@ -641,15 +666,42 @@ class HashJoinExec(ExecutionPlan):
         with self.metrics.time("probe_time"):
             return fn(bt, probe, first, count)
 
+    def _contig_probe(self, bt, flags, from_cache, ctx, fp) -> bool:
+        """Whether to take the contiguous-key probe path. Fresh flags are
+        authoritative for this build; cached flags are speculative and get
+        a deferred validation against the actual build's device flag."""
+        contig = len(flags) > 2 and bool(flags[2])
+        if contig and from_cache and ctx is not None and fp:
+            import jax.numpy as jnp
+
+            flag = (
+                bt.contiguous
+                if bt.contiguous is not None
+                else jnp.ones((), bool)
+            )
+            ctx.defer_speculation(
+                ~flag,
+                "cached contiguous-build-key speculation went stale",
+                [fp],
+            )
+        return contig
+
     def _probe_with_filter(
-        self, bt, probe: DeviceBatch, probe_keys: list[int], kind: JoinSide
+        self,
+        bt,
+        probe: DeviceBatch,
+        probe_keys: list[int],
+        kind: JoinSide,
+        contiguous: bool = False,
     ) -> DeviceBatch:
         """Probe (jitted); apply the residual join filter to match
         semantics."""
         if self.filter is None:
             with self.metrics.time("probe_time"):
-                return _jit_probe(tuple(probe_keys), kind)(bt, probe)
-        key = (tuple(probe_keys), kind)
+                return _jit_probe(tuple(probe_keys), kind, contiguous)(
+                    bt, probe
+                )
+        key = (tuple(probe_keys), kind, contiguous)
         fn = self._filtered_probe_cache.get(key)
         if fn is None:
             filt = self.filter
@@ -658,8 +710,12 @@ class HashJoinExec(ExecutionPlan):
             def run(bt, probe):
                 # Residual filters see probe ++ build columns: join LEFT-like
                 # first, evaluate, then adjust validity per join kind.
-                joined = probe_side(bt, probe, pk, JoinSide.LEFT)
-                matched = probe_side(bt, probe, pk, JoinSide.INNER).valid
+                joined = probe_side(
+                    bt, probe, pk, JoinSide.LEFT, contiguous=contiguous
+                )
+                matched = probe_side(
+                    bt, probe, pk, JoinSide.INNER, contiguous=contiguous
+                ).valid
                 phys = compile_expr(filt, joined.schema)
                 cv = phys.evaluate(joined)
                 passes = cv.values.astype(bool)

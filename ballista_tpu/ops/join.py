@@ -121,6 +121,11 @@ class BuildTable:
     mode: str  # packing mode: "exact" | "exact2" | "hash"
     has_dups: jnp.ndarray  # bool scalar: duplicate keys among live rows
     run_overflow: jnp.ndarray  # bool scalar: collision run > COLLISION_WINDOW
+    # contiguous-range fast probe (TPC-H dimension keys are 1..N): when the
+    # live keys are exactly [lo, lo+n-1] with no dups, a probe is
+    # ``key - lo`` + range check — no binary search, no verify gather.
+    lo: jnp.ndarray | None = None  # int64 scalar: smallest live key
+    contiguous: jnp.ndarray | None = None  # bool scalar
 
     @property
     def exact(self) -> bool:
@@ -130,18 +135,20 @@ class BuildTable:
     def tree_flatten(self):
         leaves = (
             self.batch, self.keys, self.key_cols, self.n,
-            self.has_dups, self.run_overflow,
+            self.has_dups, self.run_overflow, self.lo, self.contiguous,
         )
         return leaves, (tuple(self.key_idxs), self.mode)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        batch, keys, key_cols, n, has_dups, run_overflow = leaves
+        (batch, keys, key_cols, n, has_dups, run_overflow, lo,
+         contiguous) = leaves
         key_idxs, mode = aux
         return cls(
             batch=batch, keys=keys, key_cols=list(key_cols),
             key_idxs=list(key_idxs), n=n, mode=mode,
             has_dups=has_dups, run_overflow=run_overflow,
+            lo=lo, contiguous=contiguous,
         )
 
     def spec_flag(self):
@@ -150,20 +157,28 @@ class BuildTable:
         cached build-strategy decisions — no host sync."""
         return jnp.logical_or(self.has_dups, self.run_overflow)
 
-    def flags(self) -> tuple[bool, bool]:
-        """(has_dups, run_overflow) fetched in ONE device round-trip and
-        cached (each scalar sync costs ~100ms over a tunnelled TPU)."""
+    def flags(self) -> tuple[bool, bool, bool]:
+        """(has_dups, run_overflow, contiguous) fetched in ONE device
+        round-trip and cached (each scalar sync costs ~100ms over a
+        tunnelled TPU)."""
         cached = getattr(self, "_flags_cache", None)
         if cached is None:
             from ballista_tpu.ops.fetch import fetch_arrays
 
-            d, o = fetch_arrays([self.has_dups, self.run_overflow])
-            cached = (bool(d), bool(o))
+            contig = (
+                self.contiguous
+                if self.contiguous is not None
+                else jnp.zeros((), bool)
+            )
+            d, o, c = fetch_arrays(
+                [self.has_dups, self.run_overflow, contig]
+            )
+            cached = (bool(d), bool(o), bool(c))
             object.__setattr__(self, "_flags_cache", cached)
         return cached
 
     def check_unique(self) -> None:
-        dups, overflow = self.flags()
+        dups, overflow = self.flags()[:2]
         if dups:
             raise ExecutionError(
                 "join build side has duplicate keys; only unique-build "
@@ -247,6 +262,18 @@ def _build_finish(perm, dead, packed, batch: DeviceBatch, key_idxs: tuple,
             eq = eq & (kc[j:] == kc[:-j])
         dup = dup | jnp.any(pair_live & same_run & eq)
 
+    if mode == "exact":
+        # live keys exactly [lo, lo+n-1] and unique <=> min + count pin the
+        # max; probes then index directly (see probe_side contiguous path)
+        lo = keys_sorted[0]
+        last = keys_sorted[jnp.clip(n - 1, 0, cap - 1)]
+        contiguous = (
+            (n > 0) & ~dup & (last - lo == (n - 1).astype(jnp.int64))
+        )
+    else:
+        lo = jnp.zeros((), jnp.int64)
+        contiguous = jnp.zeros((), dtype=bool)
+
     if mode != "hash":
         run_overflow = jnp.zeros((), dtype=bool)
     else:
@@ -269,6 +296,8 @@ def _build_finish(perm, dead, packed, batch: DeviceBatch, key_idxs: tuple,
         mode=mode,
         has_dups=dup,
         run_overflow=run_overflow,
+        lo=lo,
+        contiguous=contiguous,
     )
 
 
@@ -327,12 +356,18 @@ def probe_side(
     probe_key_idxs: list[int],
     join_type: JoinSide,
     out_schema: Schema | None = None,
+    contiguous: bool = False,
 ) -> DeviceBatch:
-    """Probe and construct the joined batch (probe-capacity output)."""
+    """Probe and construct the joined batch (probe-capacity output).
+
+    ``contiguous=True`` (static): the caller asserts — validated via the
+    deferred-speculation protocol against ``build.contiguous`` — that the
+    live build keys are exactly ``[lo, lo+n-1]`` and unique, so the match
+    row is ``key - lo`` with a range check: no binary search, no verify
+    gather (the dimension-table shape of every TPC-H PK)."""
     _check_join_dictionaries(build, probe, probe_key_idxs)
     probe_keys = [probe.columns[i] for i in probe_key_idxs]
     packed = _pack_key(probe_keys, build.mode)
-    idx = searchsorted(build.keys, packed)
     cap_b = build.keys.shape[0]
 
     live = probe.valid
@@ -342,20 +377,27 @@ def probe_side(
         if nm is not None:
             live = live & ~nm
 
-    # Window scan over the packed-key run: actual-key equality implies equal
-    # packed keys, so every true match lies within the run starting at idx.
-    window = 1 if build.exact else COLLISION_WINDOW
-    match = jnp.zeros(probe.capacity, dtype=bool)
-    cand = jnp.clip(idx, 0, cap_b - 1)
-    for j in range(window):
-        cand_j = jnp.clip(idx + j, 0, cap_b - 1)
-        ok = (idx + j < build.n) & live
-        for bk, pk in zip(build.key_cols, probe_keys):
-            # jnp promotion (x64 on) widens mixed int32/int64 correctly;
-            # never cast the probe down to the build dtype.
-            ok = ok & (bk[cand_j] == pk)
-        cand = jnp.where(ok & ~match, cand_j, cand)
-        match = match | ok
+    if contiguous:
+        rel = packed - build.lo
+        match = live & (rel >= 0) & (rel < build.n.astype(jnp.int64))
+        cand = jnp.clip(rel, 0, cap_b - 1).astype(jnp.int32)
+    else:
+        idx = searchsorted(build.keys, packed)
+        # Window scan over the packed-key run: actual-key equality implies
+        # equal packed keys, so every true match lies within the run
+        # starting at idx.
+        window = 1 if build.exact else COLLISION_WINDOW
+        match = jnp.zeros(probe.capacity, dtype=bool)
+        cand = jnp.clip(idx, 0, cap_b - 1)
+        for j in range(window):
+            cand_j = jnp.clip(idx + j, 0, cap_b - 1)
+            ok = (idx + j < build.n) & live
+            for bk, pk in zip(build.key_cols, probe_keys):
+                # jnp promotion (x64 on) widens mixed int32/int64
+                # correctly; never cast the probe down to the build dtype.
+                ok = ok & (bk[cand_j] == pk)
+            cand = jnp.where(ok & ~match, cand_j, cand)
+            match = match | ok
 
     if join_type == JoinSide.SEMI:
         return probe.with_valid(match)

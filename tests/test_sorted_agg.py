@@ -31,17 +31,6 @@ def _oracle(keys, vals, valid, op):
     return df.groupby("k").v.count()
 
 
-def _result_frame(res, n_vals=1):
-    keys = np.asarray(res.keys[0])
-    valid = np.asarray(res.valid)
-    out = {}
-    for g, v in zip(
-        keys[valid], np.asarray(res.values[0])[valid]
-    ):
-        out[g] = v
-    return out
-
-
 @pytest.mark.parametrize("presorted", [False, True])
 def test_clustered_sum_count_min_max(presorted):
     rng = np.random.default_rng(7)
