@@ -154,6 +154,13 @@ def _stacked_scatter_set(rid, capacity: int, cols: list) -> list:
 _MATMUL_MAX_SLOTS = 2048
 _MATMUL_MAX_ONEHOT_BYTES = 512 << 20
 
+# The pallas kernel (ops/pallas_agg.py) replaces the XLA one-hot matmul on
+# big batches only: its f32 in-block accumulation carries ~1e-8 relative
+# error, acceptable for SQL sums at scale (no defined summation order) but
+# above what small-data unit tests assert (rtol=1e-9). Below the bar the
+# XLA f64 path is cheap anyway.
+_PALLAS_MIN_ROWS = 1 << 20
+
 
 def _stacked_reduce(
     rid, capacity: int, vals: list, lives: list, ops: tuple
@@ -179,6 +186,11 @@ def _stacked_reduce(
         return out_vals, out_val_nulls
     n = rid.shape[0]
     use_mm = capacity <= _MATMUL_MAX_SLOTS
+    use_pallas = False
+    if use_mm and n >= _PALLAS_MIN_ROWS:
+        from ballista_tpu.ops import pallas_agg
+
+        use_pallas = pallas_agg.available()
 
     # chunk so the materialized (capacity, chunk) f64 one-hot stays within
     # budget; rows beyond n (chunk padding) and dropped rows (rid ==
@@ -220,7 +232,20 @@ def _stacked_reduce(
         )
         return acc
 
-    if use_mm:
+    add_groups: dict[str, list] = {}
+    min_groups: dict[str, list] = {}
+    max_groups: dict[str, list] = {}
+    if use_pallas:
+        # ONE kernel call covers the count matrix and every f64 sum: live
+        # flags ride as f32 0/1 rows (counts stay exact — see module note
+        # in pallas_agg), f64 contributions as exact (hi, lo) f32 pairs.
+        from ballista_tpu.ops import pallas_agg
+
+        rows = [l.astype(jnp.float32) for l in lives]
+        f64_cols: list[int] = []
+        contribs_f64: dict[int, jnp.ndarray] = {}
+        nonnull = None  # filled after the single kernel call below
+    elif use_mm:
         cnt_mat = jnp.stack([l.astype(jnp.float64) for l in lives], axis=1)
         nonnull = _mm(cnt_mat).astype(jnp.int64)
     else:
@@ -228,17 +253,19 @@ def _stacked_reduce(
         nonnull = jnp.zeros((capacity, m), dtype=jnp.int64).at[rid].add(
             cnt_mat, mode="drop"
         )
-    add_groups: dict[str, list] = {}
-    min_groups: dict[str, list] = {}
-    max_groups: dict[str, list] = {}
     for i, (vc, live, op) in enumerate(zip(vals, lives, ops)):
         if op == AggOp.COUNT:
-            out_vals[i] = nonnull[:, i]
             continue
-        out_val_nulls[i] = nonnull[:, i] == 0  # agg over no values is NULL
         if op == AggOp.SUM:
             acc_t = _sum_dtype(vc.dtype)
             contrib = jnp.where(live, vc, jnp.zeros_like(vc)).astype(acc_t)
+            if use_pallas and jnp.dtype(acc_t) == jnp.float64:
+                hi, lo = pallas_agg.split_hi_lo(contrib)
+                rows.append(hi)
+                rows.append(lo)
+                f64_cols.append(i)
+                contribs_f64[i] = contrib
+                continue
             add_groups.setdefault(
                 str(jnp.dtype(acc_t)), []
             ).append((i, contrib))
@@ -250,6 +277,40 @@ def _stacked_reduce(
             max_groups.setdefault(str(vc.dtype), []).append((i, masked))
         else:  # pragma: no cover
             raise ExecutionError(f"unknown agg op {op}")
+    if use_pallas:
+        sums = pallas_agg.onehot_sums(rid, rows, capacity)
+        nonnull = jnp.round(sums[:, :m]).astype(jnp.int64)
+        if f64_cols:
+            # The kernel accumulates in f32: a value beyond ~1e30 (or a
+            # NaN/Inf input) would overflow hi/lo or poison every slot of
+            # its column. Guard on the contributions' magnitude and fall
+            # back to the XLA f64 one-hot path for the f64 sums — rare
+            # enough that the cond's cold branch never runs in practice.
+            f64_stack = jnp.stack(
+                [contribs_f64[i] for i in f64_cols], axis=1
+            )
+            in_range = jnp.max(jnp.abs(jnp.where(
+                jnp.isfinite(f64_stack), f64_stack, jnp.inf
+            ))) < 1e30
+            pallas_sums = jnp.stack(
+                [
+                    sums[:, m + 2 * j] + sums[:, m + 2 * j + 1]
+                    for j in range(len(f64_cols))
+                ],
+                axis=1,
+            )
+            safe = jax.lax.cond(
+                in_range,
+                lambda: pallas_sums,
+                lambda: _mm(f64_stack),
+            )
+            for j, i in enumerate(f64_cols):
+                out_vals[i] = safe[:, j]
+    for i, op in enumerate(ops):
+        if op == AggOp.COUNT:
+            out_vals[i] = nonnull[:, i]
+        else:
+            out_val_nulls[i] = nonnull[:, i] == 0  # agg over no values: NULL
     for groups, kind in (
         (add_groups, "add"), (min_groups, "min"), (max_groups, "max")
     ):
@@ -521,9 +582,11 @@ def _seg_part1(
     sid = jnp.where(row_valid, seg, capacity)
     hint = not clustered
 
-    pe = jnp.full(capacity, -1, jnp.int32).at[sid].max(
-        iota, mode="drop", indices_are_sorted=hint
-    )
+    # Segment START positions only. End positions are never materialized:
+    # dead rows contribute zero to every running sum, so the cumsum just
+    # before one segment's start equals the cumsum at the previous
+    # segment's end — part2 reconstructs per-segment totals from the
+    # starts alone (one boundary gather instead of two, no scatter-max).
     ps = jnp.full(capacity, n, jnp.int32).at[sid].min(
         iota, mode="drop", indices_are_sorted=hint
     )
@@ -576,7 +639,6 @@ def _seg_part1(
         overflow,
         input_was_sorted,
         sorted_ok,
-        pe,
         ps,
         cnt_cs,
         sum_cs,
@@ -586,7 +648,6 @@ def _seg_part1(
 
 def _seg_part2(
     n_groups,
-    pe,
     ps,
     cnt_cs,
     sum_cs: list,
@@ -599,20 +660,25 @@ def _seg_part2(
     live_layout: tuple,
     mm_idx: tuple,
 ):
-    """Program 2: boundary gathers -> per-group outputs."""
+    """Program 2: ONE boundary gather per stacked cumsum -> per-group
+    totals. ``pre[g] = cs[ps_g - 1]`` (0 when ``ps_g == 0``); since dead
+    rows contribute nothing, ``pre[g+1]`` is exactly the cumsum at segment
+    g's end, so ``totals[g] = pre[g+1] - pre[g]`` with the last live group
+    closed by the grand total ``cs[n-1]``. Dead slots (``ps == n``
+    sentinel) gather the grand total on both sides and cancel to zero."""
     n = cnt_cs.shape[0]
     slot = jnp.arange(capacity, dtype=jnp.int32)
     out_valid = slot < n_groups
-    pe_c = jnp.clip(pe, 0, n - 1)
     ps_c = jnp.clip(ps, 0, n - 1)
     ps_prev = jnp.clip(ps_c - 1, 0, n - 1)
-    has_prev = (ps > 0) & out_valid
+    is_last = slot == n_groups - 1
 
     def seg_totals(cs2d):
-        # two row-gathers per stacked cumsum (end rows, pre-start rows)
-        ends = cs2d[pe_c]
-        pre = jnp.where(has_prev[:, None], cs2d[ps_prev], 0)
-        return ends - pre
+        pre = jnp.where((ps > 0)[:, None], cs2d[ps_prev], 0)
+        total = cs2d[n - 1]
+        nxt = jnp.concatenate([pre[1:], pre[-1:]])
+        nxt = jnp.where(is_last[:, None], total[None, :], nxt)
+        return nxt - pre
 
     cnt_tot = seg_totals(cnt_cs)
     live_slot = {k: j for j, k in enumerate(live_layout)}
@@ -698,7 +764,7 @@ def _segment_aggregate(
         tuple(ops),
     )
     (
-        n_groups, overflow, input_was_sorted, sorted_ok, pe, ps,
+        n_groups, overflow, input_was_sorted, sorted_ok, ps,
         cnt_cs, sum_cs, mm_vals,
     ) = _seg_part1_jit(
         valid, list(key_cols), list(key_nulls), list(val_cols),
@@ -706,7 +772,7 @@ def _segment_aggregate(
         sum_layout, live_layout, mm_idx,
     )
     res = _seg_part2_jit(
-        n_groups, pe, ps, cnt_cs, list(sum_cs), list(mm_vals),
+        n_groups, ps, cnt_cs, list(sum_cs), list(mm_vals),
         list(key_cols), list(key_nulls), tuple(ops), capacity,
         sum_layout, live_layout, mm_idx,
     )
@@ -864,6 +930,11 @@ def dense_group_aggregate(
     ops: list[AggOp],
 ) -> GroupAggResult:
     """Sort-free aggregation over dictionary codes (see ``_dense_agg``)."""
+    # resolve the pallas-availability probe OUTSIDE the jit trace (it runs
+    # a tiny trial kernel; the answer is cached for the process)
+    from ballista_tpu.ops import pallas_agg
+
+    pallas_agg.available()
     return _dense_agg_jit(
         list(key_codes), list(key_nulls), tuple(vocab_sizes), valid,
         list(val_cols), list(val_nulls), tuple(ops),

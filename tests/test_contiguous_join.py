@@ -122,7 +122,7 @@ def test_engine_contiguous_join_learns_and_recovers():
     want = (fp.v + fp.w).sum()
     for run in (1, 2):
         got = ctx.sql(sql).collect().to_pandas()["s"][0]
-        np.testing.assert_allclose(got, want, rtol=1e-9), run
+        np.testing.assert_allclose(got, want, rtol=1e-9, err_msg=f"run {run}")
     assert any(
         isinstance(v, tuple) and len(v) > 2 and v[2]
         for v in ctx._plan_cache.values()
