@@ -346,11 +346,13 @@ class HashJoinExec(ExecutionPlan):
                         lbt.spec_flag(),
                         "cached join build strategy went stale (flip side "
                         "no longer unique)",
-                        [lfp],
+                        [lfp, ("join_lut", lfp)],
                     )
                 contig = self._contig_probe(
                     lbt, lflags, l_from_cache, ctx, lfp
                 )
+                if not contig:
+                    self._maybe_attach_lut(lbt, rb.capacity, ctx, lfp)
                 joined = self._probe_with_filter(
                     lbt, rb, right_keys, JoinSide.INNER, contig
                 )
@@ -370,8 +372,9 @@ class HashJoinExec(ExecutionPlan):
                         lbt.run_overflow,
                         "cached join build strategy went stale (collision "
                         "overflow appeared)",
-                        [lfp],
+                        [lfp, ("join_lut", lfp)],
                     )
+                self._maybe_attach_lut(lbt, rb.capacity, ctx, lfp)
                 joined = self._expand_with_filter(
                     lbt, rb, right_keys, JoinSide.INNER, ctx, lfp, 0
                 )
@@ -391,7 +394,7 @@ class HashJoinExec(ExecutionPlan):
                         rbt.run_overflow,
                         "cached join build strategy went stale (collision "
                         "overflow appeared)",
-                        [fp],
+                        [fp, ("join_lut", fp)],
                     )
                 else:
                     ctx.defer_check(
@@ -400,6 +403,7 @@ class HashJoinExec(ExecutionPlan):
                         "longer than the probe window; use an integer join "
                         "key or reduce build size",
                     )
+                self._maybe_attach_lut(rbt, lb.capacity, ctx, fp)
                 out = self._expand_with_filter(
                     rbt, lb, left_keys, JoinSide.INNER, ctx, fp, 0
                 )
@@ -419,7 +423,7 @@ class HashJoinExec(ExecutionPlan):
                     bt.spec_flag(),
                     "cached join build strategy went stale (build side no "
                     "longer unique)",
-                    [fp],
+                    [fp, ("join_lut", fp)],
                 )
             else:
                 ctx.defer_check(
@@ -460,12 +464,64 @@ class HashJoinExec(ExecutionPlan):
                 _validate(bt)
                 contig = False
                 base = bb2
+            if not contig:
+                self._maybe_attach_lut(bt, pb.capacity, ctx, fp)
             joined = self._probe_with_filter(
                 bt, pb, left_keys, JoinSide.INNER, contig
             )
             out = self._restore_column_order(joined, pb, bt.batch, True)
             self.metrics.add("output_batches")
             yield out
+
+    # Probes below this capacity don't amortize a table build (the
+    # searchsorted scan method is cheap on small query vectors anyway).
+    _LUT_MIN_PROBE = 1 << 17
+
+    def _maybe_attach_lut(self, bt, probe_cap: int, ctx, fp) -> None:
+        """Attach a direct-address probe table (ops/join.attach_lut) when
+        the build has exact int keys over a bounded domain and the probe
+        is big. The domain comes from the build's one-trip flags fetch
+        (cold) or the plan cache (warm — validated by a deferred device
+        flag, so an outgrown domain triggers invalidate-and-retry instead
+        of silently dropping matches)."""
+        from ballista_tpu.ops.join import (
+            LUT_MAX_DOMAIN,
+            attach_lut,
+            lut_stale,
+        )
+
+        if (
+            bt.lut2 is not None
+            or bt.mode != "exact"
+            or probe_cap < self._LUT_MIN_PROBE
+        ):
+            return
+        cache = ctx.plan_cache if ctx is not None else None
+        key = ("join_lut", fp) if fp else None
+        cached = cache.get(key) if (cache is not None and key) else None
+        if cached == 0:  # learned: contiguous or domain too wide
+            return
+        if cached is not None:
+            attach_lut(bt, cached)
+            ctx.defer_speculation(
+                lut_stale(bt, cached),
+                "cached join probe-table domain went stale (keys outgrew "
+                "it)",
+                [key],
+            )
+            return
+        flags = bt.flags()  # one fetch, memoized per build
+        contig = len(flags) > 2 and bool(flags[2])
+        lo, hi = (flags[3], flags[4]) if len(flags) > 4 else (0, -1)
+        domain = hi - lo + 1
+        if contig or domain <= 0 or domain > LUT_MAX_DOMAIN:
+            if cache is not None and key:
+                cache[key] = 0
+            return
+        size = round_capacity(domain)
+        attach_lut(bt, size)
+        if cache is not None and key:
+            cache[key] = size
 
     def _strategy_key(self, side_plan, keys: list[int], ctx, partition=None):
         """Cross-query plan-cache key for a build side: structural plan
@@ -507,9 +563,11 @@ class HashJoinExec(ExecutionPlan):
                     bt.spec_flag(),
                     "cached join build strategy went stale (build side no "
                     "longer unique)",
-                    [fp],
+                    [fp, ("join_lut", fp)],
                 )
                 contig = self._contig_probe(bt, cached, True, ctx, fp)
+                if not contig:
+                    self._maybe_attach_lut(bt, probe.capacity, ctx, fp)
                 return self._probe_with_filter(
                     bt, probe, probe_keys, kind, contig
                 )
@@ -519,8 +577,9 @@ class HashJoinExec(ExecutionPlan):
                 bt.run_overflow,
                 "cached join build strategy went stale (collision overflow "
                 "appeared)",
-                [fp],
+                [fp, ("join_lut", fp)],
             )
+            self._maybe_attach_lut(bt, probe.capacity, ctx, fp)
             return self._expand_with_filter(
                 bt, probe, probe_keys, kind, ctx, fp, partition
             )
@@ -535,9 +594,12 @@ class HashJoinExec(ExecutionPlan):
             bt.check_overflow()
         if not dups:
             contig = self._contig_probe(bt, flags, False, ctx, fp)
+            if not contig:
+                self._maybe_attach_lut(bt, probe.capacity, ctx, fp)
             return self._probe_with_filter(
                 bt, probe, probe_keys, kind, contig
             )
+        self._maybe_attach_lut(bt, probe.capacity, ctx, fp)
         return self._expand_with_filter(
             bt, probe, probe_keys, kind, ctx, fp, partition
         )
@@ -682,7 +744,7 @@ class HashJoinExec(ExecutionPlan):
             ctx.defer_speculation(
                 ~flag,
                 "cached contiguous-build-key speculation went stale",
-                [fp],
+                [fp, ("join_lut", fp)],
             )
         return contig
 

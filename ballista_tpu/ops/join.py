@@ -126,6 +126,12 @@ class BuildTable:
     # ``key - lo`` + range check — no binary search, no verify gather.
     lo: jnp.ndarray | None = None  # int64 scalar: smallest live key
     contiguous: jnp.ndarray | None = None  # bool scalar
+    hi: jnp.ndarray | None = None  # int64 scalar: largest live key (exact)
+    # direct-address probe table for exact int keys in a bounded domain
+    # (see attach_lut): lut2[k - lo] = (first sorted row, run length).
+    # Replaces the per-probe-batch sorted searchsorted (~220ms at 6M
+    # probes on a v5e) with one stacked gather (~70ms).
+    lut2: jnp.ndarray | None = None  # int32[(domain, 2)]
 
     @property
     def exact(self) -> bool:
@@ -136,19 +142,20 @@ class BuildTable:
         leaves = (
             self.batch, self.keys, self.key_cols, self.n,
             self.has_dups, self.run_overflow, self.lo, self.contiguous,
+            self.hi, self.lut2,
         )
         return leaves, (tuple(self.key_idxs), self.mode)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
         (batch, keys, key_cols, n, has_dups, run_overflow, lo,
-         contiguous) = leaves
+         contiguous, hi, lut2) = leaves
         key_idxs, mode = aux
         return cls(
             batch=batch, keys=keys, key_cols=list(key_cols),
             key_idxs=list(key_idxs), n=n, mode=mode,
             has_dups=has_dups, run_overflow=run_overflow,
-            lo=lo, contiguous=contiguous,
+            lo=lo, contiguous=contiguous, hi=hi, lut2=lut2,
         )
 
     def spec_flag(self):
@@ -157,10 +164,11 @@ class BuildTable:
         cached build-strategy decisions — no host sync."""
         return jnp.logical_or(self.has_dups, self.run_overflow)
 
-    def flags(self) -> tuple[bool, bool, bool]:
-        """(has_dups, run_overflow, contiguous) fetched in ONE device
-        round-trip and cached (each scalar sync costs ~100ms over a
-        tunnelled TPU)."""
+    def flags(self) -> tuple:
+        """(has_dups, run_overflow, contiguous, lo, hi) fetched in ONE
+        device round-trip and cached (each scalar sync costs ~100ms over a
+        tunnelled TPU). lo/hi are the live-key extremes (exact mode; 0
+        otherwise) — they size the direct-address probe table."""
         cached = getattr(self, "_flags_cache", None)
         if cached is None:
             from ballista_tpu.ops.fetch import fetch_arrays
@@ -170,10 +178,17 @@ class BuildTable:
                 if self.contiguous is not None
                 else jnp.zeros((), bool)
             )
-            d, o, c = fetch_arrays(
-                [self.has_dups, self.run_overflow, contig]
+            zero = jnp.zeros((), jnp.int64)
+            d, o, c, lo, hi = fetch_arrays(
+                [
+                    self.has_dups,
+                    self.run_overflow,
+                    contig,
+                    self.lo if self.lo is not None else zero,
+                    self.hi if self.hi is not None else zero,
+                ]
             )
-            cached = (bool(d), bool(o), bool(c))
+            cached = (bool(d), bool(o), bool(c), int(lo), int(hi))
             object.__setattr__(self, "_flags_cache", cached)
         return cached
 
@@ -270,9 +285,11 @@ def _build_finish(perm, dead, packed, batch: DeviceBatch, key_idxs: tuple,
         contiguous = (
             (n > 0) & ~dup & (last - lo == (n - 1).astype(jnp.int64))
         )
+        hi = last
     else:
         lo = jnp.zeros((), jnp.int64)
         contiguous = jnp.zeros((), dtype=bool)
+        hi = jnp.zeros((), jnp.int64)
 
     if mode != "hash":
         run_overflow = jnp.zeros((), dtype=bool)
@@ -298,6 +315,7 @@ def _build_finish(perm, dead, packed, batch: DeviceBatch, key_idxs: tuple,
         run_overflow=run_overflow,
         lo=lo,
         contiguous=contiguous,
+        hi=hi,
     )
 
 
@@ -350,6 +368,55 @@ def build_side(batch: DeviceBatch, key_idxs: list[int]) -> BuildTable:
     )
 
 
+# Direct-address probe tables stay below this domain span (i32 pairs:
+# 64M keys = 512MB HBM at the cap — well within a v5e's 16GB next to the
+# operands it serves).
+LUT_MAX_DOMAIN = 1 << 26
+
+
+@functools.lru_cache(maxsize=None)
+def _lut_program(size: int, cap_b: int):
+    """(keys_sorted, lo, n) -> int32[(size, 2)] direct-address table:
+    row k-lo = (first sorted build row with key k, run length). Both
+    scatters ride sorted indices (the build is key-sorted; the dead tail's
+    INT64_MAX keys map far out of range and drop)."""
+
+    def f(keys_sorted, lo, n):
+        iota = jnp.arange(cap_b, dtype=jnp.int32)
+        # Dead-tail rows get a clean ``size`` sentinel BEFORE the i32
+        # narrow: the raw INT64_MAX - lo value truncates arbitrarily under
+        # the TPU x64 emulation, which both aliases in-range slots and
+        # breaks the sorted-indices contract (UB). Live rels are sorted
+        # and < size; the sentinel keeps the run monotone and drops.
+        rel64 = jnp.where(iota < n, keys_sorted - lo, jnp.int64(size))
+        rel = jnp.clip(rel64, 0, size).astype(jnp.int32)
+        first = jnp.full(size, cap_b, jnp.int32).at[rel].min(
+            iota, mode="drop", indices_are_sorted=True
+        )
+        count = jnp.zeros(size, jnp.int32).at[rel].add(
+            1, mode="drop", indices_are_sorted=True
+        )
+        return jnp.stack([jnp.where(count > 0, first, 0), count], axis=1)
+
+    return jax.jit(f)
+
+
+def attach_lut(build: BuildTable, size: int) -> None:
+    """Build and attach the direct-address probe table (host-composed,
+    dispatch is async). ``size`` must cover ``hi - lo + 1`` — callers
+    validate that either from fresh flags (cold) or via a deferred device
+    flag (warm, see exec/joins.py)."""
+    build.lut2 = _lut_program(size, build.keys.shape[0])(
+        build.keys, build.lo, build.n
+    )
+
+
+def lut_stale(build: BuildTable, size: int):
+    """Device bool: the attached table no longer covers the live-key
+    domain (deferred-speculation validator for cached table sizes)."""
+    return (build.hi - build.lo) >= jnp.int64(size)
+
+
 def probe_side(
     build: BuildTable,
     probe: DeviceBatch,
@@ -381,6 +448,15 @@ def probe_side(
         rel = packed - build.lo
         match = live & (rel >= 0) & (rel < build.n.astype(jnp.int64))
         cand = jnp.clip(rel, 0, cap_b - 1).astype(jnp.int32)
+    elif build.lut2 is not None:
+        # direct-address table: one stacked gather, no binary search and
+        # no verify pass (exact packing is injective)
+        size = build.lut2.shape[0]
+        rel = packed - build.lo
+        inb = live & (rel >= 0) & (rel < size)
+        g = build.lut2[jnp.clip(rel, 0, size - 1).astype(jnp.int32)]
+        match = inb & (g[:, 1] > 0)
+        cand = jnp.clip(g[:, 0], 0, cap_b - 1)
     else:
         idx = searchsorted(build.keys, packed)
         # Window scan over the packed-key run: actual-key equality implies
@@ -465,6 +541,15 @@ def probe_counts(
     cap_b = build.keys.shape[0]
 
     if build.mode != "hash":
+        if build.lut2 is not None:
+            # first row + run length in one stacked gather (vs TWO sorted
+            # searchsorted passes for the left/right run edges)
+            size = build.lut2.shape[0]
+            rel = packed - build.lo
+            inb = live & (rel >= 0) & (rel < size)
+            g = build.lut2[jnp.clip(rel, 0, size - 1).astype(jnp.int32)]
+            count = jnp.where(inb, g[:, 1], 0)
+            return g[:, 0], count, live
         lo = searchsorted(build.keys, packed, side="left")
         hi = searchsorted(build.keys, packed, side="right")
         # Dead tail keys are INT64_MAX; clamping to n keeps a probe key of

@@ -37,7 +37,8 @@ def test_build_detects_contiguous_range():
     keys = np.arange(10, 60, dtype=np.int64)
     np.random.default_rng(0).shuffle(keys)
     bt = build_side(_batch({"k": keys, "p": keys * 2.0}), [0])
-    assert bt.flags() == (False, False, True)
+    assert bt.flags()[:3] == (False, False, True)
+    assert bt.flags()[3:] == (10, 59)  # live-key extremes ride the fetch
     assert int(bt.lo) == 10
 
     holes = np.array([1, 2, 4, 5], dtype=np.int64)

@@ -47,8 +47,8 @@ r1 = ctx.sql(sql).collect().to_pandas().s[0]
 np.testing.assert_allclose(r1, oracle(dim_unique), rtol=1e-9)
 key = [k for k in ctx._plan_cache if k[0] == "join_flags"]
 assert key, ctx._plan_cache
-# (dups, overflow, contiguous): dim ids 0..49 are a contiguous PK range
-assert ctx._plan_cache[key[0]] == (False, False, True)
+# (dups, overflow, contiguous, lo, hi): ids 0..49 are a contiguous PK range
+assert ctx._plan_cache[key[0]][:3] == (False, False, True)
 
 # run 2: warm — same data, cached strategy, still correct
 r2 = ctx.sql(sql).collect().to_pandas().s[0]
