@@ -34,12 +34,15 @@ from ballista_tpu.columnar.batch import DeviceBatch, round_capacity
 # Below this capacity a shrink cannot pay for its own compaction.
 SHRINK_MIN_CAP = 4096
 # Shrink only when the new capacity is at most old/RATIO. The compaction
-# pass costs ~an argsort of the OLD capacity per batch with no knowledge
-# of how much downstream work it saves, so the bar is deliberately high:
-# a merely-selective filter (TPC-H q6 keeps ~2% -> ratio 8) loses ~170ms
-# per batch for a one-op tail, while the q18 HAVING/semi-join sites
-# (ratio >= 512) save seconds of full-capacity sorts.
-SHRINK_RATIO = 64
+# pass costs ~a bool argsort of the OLD capacity plus a new-capacity
+# gather (~40ms at 8.4M on a v5e) with no knowledge of how much
+# downstream work it saves. With the round-4 kernel work the downstream
+# ops this pays into (probe gathers, build sorts, boundary gathers) all
+# scale with capacity, so a modest bar wins: at RATIO=4 TPC-H q5 drops
+# 1.12s -> 0.77s (the filtered-orders build and post-join probes run at
+# 1/4 capacity) while the worst case — a selective filter feeding a
+# one-op tail, q6 — pays ~35ms. The old bar of 64 left both on the table.
+SHRINK_RATIO = 4
 # Learned capacity = round_capacity(HEADROOM * live): room for modest
 # growth before the speculation flag fires.
 SHRINK_HEADROOM = 2

@@ -286,6 +286,22 @@ def _build_finish(perm, dead, packed, batch: DeviceBatch, key_idxs: tuple,
             (n > 0) & ~dup & (last - lo == (n - 1).astype(jnp.int64))
         )
         hi = last
+    elif mode == "exact2":
+        # Two-int-key joins: the packed sort orders by the FIRST key (the
+        # high word), so a unique contiguous first key [lo0, lo0+n-1]
+        # (TPC-H: supplier's s_suppkey in an (l_suppkey, c_nationkey) =
+        # (s_suppkey, s_nationkey) join) admits direct indexing by key0
+        # with the remaining key verified against the build row — no
+        # binary search (see probe_side's contiguous exact2 branch).
+        k0 = sorted_key_cols[0].astype(jnp.int64)
+        lo = k0[0]
+        last0 = k0[jnp.clip(n - 1, 0, cap - 1)]
+        pair_live0 = valid_sorted[1:] & valid_sorted[:-1]
+        dup0 = jnp.any(pair_live0 & (k0[1:] == k0[:-1]))
+        contiguous = (
+            (n > 0) & ~dup0 & (last0 - lo == (n - 1).astype(jnp.int64))
+        )
+        hi = jnp.zeros((), jnp.int64)  # packed extremes: no LUT for exact2
     else:
         lo = jnp.zeros((), jnp.int64)
         contiguous = jnp.zeros((), dtype=bool)
@@ -444,8 +460,13 @@ def probe_side(
         if nm is not None:
             live = live & ~nm
 
+    verify_after = False  # exact2: direct-index by key0, verify the rest
     if contiguous:
-        rel = packed - build.lo
+        if build.mode == "exact2":
+            rel = probe_keys[0].astype(jnp.int64) - build.lo
+            verify_after = True
+        else:
+            rel = packed - build.lo
         match = live & (rel >= 0) & (rel < build.n.astype(jnp.int64))
         cand = jnp.clip(rel, 0, cap_b - 1).astype(jnp.int32)
     elif build.lut2 is not None:
@@ -475,9 +496,13 @@ def probe_side(
             cand = jnp.where(ok & ~match, cand_j, cand)
             match = match | ok
 
-    if join_type == JoinSide.SEMI:
-        return probe.with_valid(match)
-    if join_type == JoinSide.ANTI:
+    if join_type in (JoinSide.SEMI, JoinSide.ANTI):
+        if verify_after:
+            vk, _ = take_many_split(list(build.key_cols), [], cand)
+            for bk, pk in zip(vk, probe_keys):
+                match = match & (bk == pk)
+        if join_type == JoinSide.SEMI:
+            return probe.with_valid(match)
         return probe.with_valid(probe.valid & ~match)
 
     # INNER / LEFT: probe columns ++ build columns gathered at the
@@ -487,6 +512,11 @@ def probe_side(
     gath_cols, gath_m = take_many_split(
         list(b.columns), list(b.nulls), cand
     )
+    if verify_after:
+        # the key columns came along in the main gather — the verify is a
+        # compare, not an extra random-access pass
+        for bi, pk in zip(build.key_idxs, probe_keys):
+            match = match & (gath_cols[bi] == pk)
     gath_nulls: list[jnp.ndarray | None] = []
     for m in gath_m:
         if join_type == JoinSide.LEFT:

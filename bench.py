@@ -16,9 +16,14 @@ BENCH_DETAIL.json and stderr.
 
 Env knobs: BENCH_SF (default 1; 0.1 for a quick run), BENCH_ITERS
 (default 3), BENCH_QUERIES (comma list, default q1,q3,q5,q6,q18),
-BENCH_SKIP_CPU=1. At the default SF=1 the device suite needs one cold
-pass of XLA compiles on a fresh cache (~20 min); warm-cache re-runs
-finish in a few minutes.
+BENCH_SKIP_CPU=1, BENCH_PREWARM=0 to disable the parallel compile
+prewarm. On a fresh compilation cache the suite's cold passes are
+dominated by serial XLA compiles (tens of seconds per program over the
+tunnelled compile service), so the harness first runs every query ONCE
+in concurrent subprocesses — the tunnelled chip multiplexes processes
+and compiles are HTTP calls that parallelize — making the fresh-cache
+wall clock ~the slowest single query instead of the sum. The measured
+suite then runs against a hot persistent cache.
 """
 
 import json
@@ -49,6 +54,19 @@ def run_suite() -> dict:
     data = gen_all(scale=SF)
     gen_s = time.time() - t0
     from ballista_tpu.config import BallistaConfig
+
+    if os.environ.get("BENCH_PREWARM_CHILD"):
+        # compile-prewarm mode: execute each query once (populating the
+        # persistent compilation cache) and exit — timings are discarded
+        ctx = TpuContext(
+            BallistaConfig().with_setting("ballista.shuffle.partitions", "1")
+        )
+        for name, t in data.items():
+            ctx.register_table(name, t)
+        for qn in QUERIES:
+            ctx.sql((QDIR / f"{qn}.sql").read_text()).collect()
+        print("{}")
+        return {}
 
     # single-chip suite: host-side partition splitting only multiplies
     # blocking syncs (the XLA program parallelizes internally); distributed
@@ -153,6 +171,75 @@ def main() -> None:
         [str(HERE)]
         + ([os.environ["PYTHONPATH"]] if os.environ.get("PYTHONPATH") else [])
     )
+    # Parallel compile prewarm: one subprocess per query, concurrently.
+    # Best-effort — failures fall through to the (slower, serial) cold
+    # pass of the measured suite. Gated to modest SF: each child
+    # regenerates the dataset in memory. A sentinel keyed by (code
+    # revision, SF, query set) skips the whole phase on hot-cache
+    # re-runs, where it could do no useful work.
+    sentinel = None
+    cache_dir = os.environ.get(
+        "BALLISTA_TPU_JAX_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "ballista_tpu_jax"),
+    )
+    if cache_dir != "off":
+        rev = ""
+        try:
+            rev = subprocess.run(
+                ["git", "-C", str(HERE), "rev-parse", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip()
+        except Exception:
+            pass
+        sentinel = pathlib.Path(cache_dir) / (
+            f"prewarmed_{rev[:12]}_{SF}_{'_'.join(QUERIES)}"
+        )
+    if (
+        os.environ.get("BENCH_PREWARM", "1") != "0"
+        and SF <= 2
+        and not (sentinel is not None and sentinel.exists())
+    ):
+        t0 = time.time()
+        procs = []
+        for qn in QUERIES:
+            env = dict(device_env)
+            env.update(
+                {
+                    "BENCH_CHILD": "1",
+                    "BENCH_PREWARM_CHILD": "1",
+                    "BENCH_SF": str(SF),
+                    "BENCH_QUERIES": qn,
+                    "BENCH_ITERS": "0",
+                }
+            )
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, str(HERE / "bench.py")],
+                    env=env,
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                )
+            )
+        deadline = time.time() + int(
+            os.environ.get("BENCH_PREWARM_TIMEOUT", 1800)
+        )
+        for p in procs:
+            try:
+                p.wait(timeout=max(1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        print(
+            f"prewarm: {len(procs)} queries compiled in "
+            f"{time.time() - t0:.0f}s",
+            file=sys.stderr,
+        )
+        if sentinel is not None:
+            try:
+                sentinel.parent.mkdir(parents=True, exist_ok=True)
+                sentinel.touch()
+            except OSError:
+                pass
+
     device_run = _run_child(
         device_env,
         ITERS,

@@ -103,3 +103,64 @@ def test_lut_probe_out_of_domain_keys_never_match():
     probe = _batch(pkeys, 64)
     _, count, _ = probe_counts(bt, probe, [0])
     assert np.asarray(count)[:7].tolist() == [0, 0, 0, 1, 1, 0, 0]
+
+
+def test_exact2_contiguous_first_key_probe():
+    """Two-int-key join with a unique contiguous FIRST key (supplier shape:
+    (l_suppkey, c_nationkey) = (s_suppkey, s_nationkey)): the build flags
+    contiguity on key0 and the probe direct-indexes + verifies the second
+    key — results must match the searchsorted path for every join kind."""
+    import jax.numpy as _jnp
+
+    rng = np.random.default_rng(0)
+    ns = 200
+    sk = np.arange(1, ns + 1).astype(np.int64)
+    natk = rng.integers(0, 25, ns).astype(np.int64)
+    cols = [sk, natk]
+    cap = 256
+    arrs = tuple(
+        _jnp.asarray(np.concatenate([v, np.zeros(cap - ns, v.dtype)]))
+        for v in cols
+    )
+    valid = _jnp.asarray(
+        np.concatenate([np.ones(ns, bool), np.zeros(cap - ns, bool)])
+    )
+    schema = Schema(
+        [Field("k0", DataType.INT64, False), Field("k1", DataType.INT64, False)]
+    )
+    b = DeviceBatch(
+        schema=schema, columns=arrs, valid=valid, nulls=(None, None),
+        dictionaries={},
+    )
+    bt = build_side(b, [0, 1])
+    assert bt.mode == "exact2"
+    assert bt.flags()[2], "key0 contiguity not detected"
+
+    n = 5000
+    pcap = 8192
+    pk0 = rng.integers(1, ns + 1, n).astype(np.int64)
+    pk1 = rng.integers(0, 25, n).astype(np.int64)
+    parrs = tuple(
+        _jnp.asarray(np.concatenate([v, np.zeros(pcap - n, v.dtype)]))
+        for v in (pk0, pk1)
+    )
+    pvalid = _jnp.asarray(
+        np.concatenate([np.ones(n, bool), np.zeros(pcap - n, bool)])
+    )
+    p = DeviceBatch(
+        schema=schema, columns=parrs, valid=pvalid, nulls=(None, None),
+        dictionaries={},
+    )
+    for kind in (JoinSide.INNER, JoinSide.SEMI, JoinSide.ANTI, JoinSide.LEFT):
+        ref = probe_side(bt, p, [0, 1], kind, contiguous=False)
+        got = probe_side(bt, p, [0, 1], kind, contiguous=True)
+        assert np.array_equal(
+            np.asarray(ref.valid), np.asarray(got.valid)
+        ), kind
+        for ci, (cr, cg) in enumerate(zip(ref.columns, got.columns)):
+            keep = np.asarray(ref.valid)
+            if ref.nulls[ci] is not None:
+                keep = keep & ~np.asarray(ref.nulls[ci])
+            assert np.array_equal(
+                np.asarray(cr)[keep], np.asarray(cg)[keep]
+            ), (kind, ci)
