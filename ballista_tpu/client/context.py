@@ -96,6 +96,9 @@ class BallistaContext(TpuContext):
             self._standalone_cluster.stop()
         self._channel.close()
 
+    def _frame(self, logical: LogicalPlan) -> DataFrame:
+        return RemoteDataFrame(self, logical)
+
     # -- query execution ------------------------------------------------------
     def sql(self, sql: str) -> DataFrame:
         stmt = parse_sql(sql)
@@ -103,7 +106,7 @@ class BallistaContext(TpuContext):
         if not isinstance(stmt, (ast.Select, ast.SetOp)):
             return super().sql(sql)
         logical = SqlPlanner(self).plan(stmt)
-        return RemoteDataFrame(self, logical)
+        return self._frame(logical)
 
     def collect_logical(self, logical: LogicalPlan) -> pa.Table:
         """Submit a logical plan, poll to completion, fetch partitions
@@ -167,6 +170,10 @@ class BallistaContext(TpuContext):
 
 
 class RemoteDataFrame(DataFrame):
+    """DataFrame whose collect() submits to the scheduler. The builder
+    methods are inherited — each derives another RemoteDataFrame, so a
+    chain started from BallistaContext.table()/read_*() runs remotely."""
+
     def collect(self) -> pa.Table:
         if self._const is not None:
             return self._const

@@ -9,6 +9,8 @@ use to run stage plans locally.
 
 from __future__ import annotations
 
+import pathlib
+
 import pyarrow as pa
 import pyarrow.csv as pacsv
 import pyarrow.parquet as papq
@@ -175,6 +177,58 @@ class TpuContext(Catalog, TableProvider):
             r.kw["path"], r.schema, projection, partitions,
         )
 
+    # -- DataFrame entry points (ref client context.rs:211-253 read_csv /
+    # read_parquet / read_avro -> DataFrame; table() as in DataFusion) ------
+    def _frame(self, logical: LogicalPlan) -> "DataFrame":
+        """Frame factory — the cluster context overrides this so builder
+        chains started from table()/read_* execute remotely."""
+        return DataFrame(self, logical)
+
+    def table(self, name: str) -> "DataFrame":
+        from ballista_tpu.plan.logical import TableScan
+
+        return self._frame(
+            TableScan(name, self.schema_of(name), source=self.source_of(name))
+        )
+
+    def _auto_name(self, path: str, kind: str) -> str:
+        """Derived registration name for read_*: the file stem, uniquified
+        when a DIFFERENT source already holds it (re-reading the same file
+        reuses the entry; '2024/data.csv' then '2025/data.csv' must not
+        silently rebind frames built on the first)."""
+        base = pathlib.Path(path).stem
+        name = base
+        i = 2
+        while name in self.tables:
+            r = self.tables[name]
+            if r.kind == kind and r.kw.get("path") == path:
+                return name
+            name = f"{base}_{i}"
+            i += 1
+        return name
+
+    def read_csv(
+        self,
+        path: str,
+        schema: Schema | None = None,
+        has_header: bool = True,
+        delimiter: str = ",",
+        name: str | None = None,
+    ) -> "DataFrame":
+        name = name or self._auto_name(path, "csv")
+        self.register_csv(name, path, schema, has_header, delimiter)
+        return self.table(name)
+
+    def read_parquet(self, path: str, name: str | None = None) -> "DataFrame":
+        name = name or self._auto_name(path, "parquet")
+        self.register_parquet(name, path)
+        return self.table(name)
+
+    def read_avro(self, path: str, name: str | None = None) -> "DataFrame":
+        name = name or self._auto_name(path, "avro")
+        self.register_avro(name, path)
+        return self.table(name)
+
     # -- SQL -----------------------------------------------------------------
     def sql_to_logical(self, sql: str) -> LogicalPlan:
         stmt = parse_sql(sql)
@@ -258,12 +312,147 @@ class TpuContext(Catalog, TableProvider):
 
 
 class DataFrame:
-    """Lazy query handle (ref: DataFusion DataFrame via BallistaContext)."""
+    """Lazy query handle with a builder API (ref: DataFusion DataFrame via
+    BallistaContext; the transformation surface mirrors the reference's
+    Python bindings — select/filter/aggregate/sort/limit/join,
+    ref:python/src/dataframe.rs:55-137). Each method returns a NEW frame
+    over an extended logical plan; ``collect`` materializes. Works
+    identically on the local TpuContext and the cluster BallistaContext
+    (RemoteDataFrame inherits these and executes remotely)."""
 
     def __init__(self, ctx: TpuContext, logical: LogicalPlan):
         self.ctx = ctx
         self.logical = logical
         self._const: pa.Table | None = None
+
+    # -- builder -------------------------------------------------------------
+    def _derive(self, logical: LogicalPlan) -> "DataFrame":
+        if self._const is not None:
+            raise PlanError("cannot build on a constant result frame")
+        return type(self)(self.ctx, logical)
+
+    @staticmethod
+    def _expr(e):
+        from ballista_tpu.expr.logical import col_or_expr
+
+        return col_or_expr(e)
+
+    def schema(self) -> Schema:
+        if self._const is not None:
+            from ballista_tpu.columnar.arrow_interop import schema_from_arrow
+
+            return schema_from_arrow(self._const.schema)
+        return self.logical.schema()
+
+    def select(self, *exprs) -> "DataFrame":
+        from ballista_tpu.plan.logical import Projection
+
+        return self._derive(
+            Projection(self.logical, tuple(self._expr(e) for e in exprs))
+        )
+
+    def select_columns(self, *names: str) -> "DataFrame":
+        return self.select(*names)
+
+    def filter(self, predicate) -> "DataFrame":
+        from ballista_tpu.plan.logical import Filter
+
+        return self._derive(Filter(self.logical, self._expr(predicate)))
+
+    where = filter
+
+    def aggregate(self, group_by: list, aggs: list) -> "DataFrame":
+        """Aggregates may be aliased (``F.sum("v").alias("total")``); the
+        execution layer wants BARE aggregate expressions (the SQL planner
+        renames through a projection, and so does this)."""
+        from ballista_tpu.expr import logical as L
+        from ballista_tpu.plan.logical import Aggregate, Projection
+
+        groups = tuple(self._expr(e) for e in group_by)
+        bare, out_names = [], []
+        for e in aggs:
+            e = self._expr(e)
+            if isinstance(e, L.Alias):
+                bare.append(e.expr)
+                out_names.append(e.aname)
+            else:
+                bare.append(e)
+                out_names.append(None)
+        plan = Aggregate(self.logical, groups, tuple(bare))
+        if any(n is not None for n in out_names):
+            proj = [L.col(g.name()) for g in groups]
+            for b, n in zip(bare, out_names):
+                c = L.col(b.name())
+                proj.append(c if n is None else c.alias(n))
+            plan = Projection(plan, tuple(proj))
+        return self._derive(plan)
+
+    def sort(self, *exprs) -> "DataFrame":
+        """Accepts ``col("x")`` (ascending), ``col("x").sort(False)``, or
+        plan-level SortExpr values."""
+        from ballista_tpu.plan.logical import Sort, SortExpr
+
+        sort_exprs = []
+        for e in exprs:
+            if isinstance(e, SortExpr):
+                sort_exprs.append(e)
+            else:
+                sort_exprs.append(self._expr(e).sort())
+        return self._derive(Sort(self.logical, tuple(sort_exprs)))
+
+    def limit(self, count: int, skip: int = 0) -> "DataFrame":
+        from ballista_tpu.plan.logical import Limit
+
+        return self._derive(Limit(self.logical, skip, count))
+
+    def join(
+        self,
+        right: "DataFrame",
+        join_keys: tuple[list[str], list[str]] | list[str],
+        how: str = "inner",
+    ) -> "DataFrame":
+        """``join_keys`` is either ``(left_cols, right_cols)`` (the
+        reference bindings' shape) or a single list of shared column
+        names."""
+        from ballista_tpu.plan.logical import Join, JoinType
+
+        if (
+            isinstance(join_keys, tuple)
+            and len(join_keys) == 2
+            and not isinstance(join_keys[0], str)
+        ):
+            lks, rks = list(join_keys[0]), list(join_keys[1])
+            if len(lks) != len(rks):
+                raise PlanError(
+                    f"join_keys sides differ in length: {len(lks)} vs "
+                    f"{len(rks)}"
+                )
+        else:
+            lks = rks = list(join_keys)
+        try:
+            jt = JoinType(how)
+        except ValueError:
+            raise PlanError(f"unknown join type {how!r}") from None
+        on = tuple(
+            (self._expr(a), self._expr(b)) for a, b in zip(lks, rks)
+        )
+        return self._derive(Join(self.logical, right.logical, on, jt))
+
+    def union(self, other: "DataFrame", all: bool = False) -> "DataFrame":
+        from ballista_tpu.plan.logical import Distinct, Union
+
+        u = Union((self.logical, other.logical), all=True)
+        return self._derive(u if all else Distinct(u))
+
+    def distinct(self) -> "DataFrame":
+        from ballista_tpu.plan.logical import Distinct
+
+        return self._derive(Distinct(self.logical))
+
+    def alias(self, name: str) -> "DataFrame":
+        from ballista_tpu.plan.logical import SubqueryAlias
+
+        return self._derive(SubqueryAlias(self.logical, name))
 
     @classmethod
     def from_arrow(cls, ctx: TpuContext, table: pa.Table) -> "DataFrame":

@@ -206,6 +206,15 @@ class Expr:
     def cast(self, dtype: DataType) -> "Cast":
         return Cast(self, dtype)
 
+    def sort(self, ascending: bool = True, nulls_first: bool | None = None):
+        """Sort-order wrapper for DataFrame.sort (ref python bindings:
+        col("x").sort(...)). Default null placement follows SQL: NULLS
+        LAST ascending, NULLS FIRST descending."""
+        from ballista_tpu.plan.logical import SortExpr
+
+        nf = (not ascending) if nulls_first is None else nulls_first
+        return SortExpr(self, ascending, nf)
+
     # equality for tests/optimizer (dataclass __eq__ is overridden by sugar)
     def same_as(self, other: "Expr") -> bool:
         return type(self) is type(other) and self._key() == other._key()
@@ -232,6 +241,13 @@ def _wrap(v) -> Expr:
     if isinstance(v, Expr):
         return v
     return Literal.infer(v)
+
+
+def col_or_expr(v) -> Expr:
+    """DataFrame-builder argument coercion: bare strings are COLUMN
+    references (pyspark/datafusion-python convention), everything else
+    wraps as usual (non-Expr -> literal)."""
+    return col(v) if isinstance(v, str) else _wrap(v)
 
 
 def col(name: str) -> "Column":

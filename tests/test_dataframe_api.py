@@ -1,0 +1,159 @@
+"""DataFrame builder API (ref:python/src/dataframe.rs:55-137 — schema /
+select / filter / aggregate / sort / limit / join / show — and the client
+context's read_csv -> DataFrame entry points, ref client context.rs:211-253).
+
+The builder must construct the same logical plans the SQL front end does,
+on both the single-process TpuContext and the cluster BallistaContext
+(RemoteDataFrame inherits the builder and executes via the scheduler).
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from ballista_tpu import functions as F
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.errors import PlanError
+from ballista_tpu.exec.context import TpuContext
+from ballista_tpu.expr.logical import col, lit
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = TpuContext(
+        BallistaConfig().with_setting("ballista.shuffle.partitions", "1")
+    )
+    rng = np.random.default_rng(11)
+    n = 500
+    c.register_table(
+        "sales",
+        pa.table(
+            {
+                "region": pa.array(rng.integers(0, 5, n)),
+                "amount": pa.array(rng.uniform(0, 100, n)),
+                "qty": pa.array(rng.integers(1, 10, n)),
+            }
+        ),
+    )
+    c.register_table(
+        "regions",
+        pa.table(
+            {
+                "id": pa.array(np.arange(5, dtype=np.int64)),
+                "name": pa.array([f"r{i}" for i in range(5)]),
+            }
+        ),
+    )
+    return c
+
+
+def test_builder_matches_sql(ctx):
+    sql = ctx.sql(
+        "select region, sum(amount) as total, count(*) as c "
+        "from sales where qty > 3 group by region order by region"
+    ).collect().to_pandas()
+
+    df = (
+        ctx.table("sales")
+        .filter(col("qty") > lit(3))
+        .aggregate(
+            [col("region")],
+            [F.sum("amount").alias("total"), F.count_star().alias("c")],
+        )
+        .sort(col("region"))
+        .collect()
+        .to_pandas()
+    )
+    pd.testing.assert_frame_equal(df, sql)
+
+
+def test_select_project_limit(ctx):
+    df = (
+        ctx.table("sales")
+        .select((col("amount") * lit(2)).alias("double"), "qty")
+        .limit(7)
+        .collect()
+    )
+    assert df.num_rows == 7
+    assert df.column_names == ["double", "qty"]
+
+
+def test_join_and_schema(ctx):
+    out = (
+        ctx.table("sales")
+        .join(ctx.table("regions"), (["region"], ["id"]), how="inner")
+        .aggregate([col("name")], [F.avg("amount").alias("a")])
+        .sort(col("name").sort(False))
+        .collect()
+        .to_pandas()
+    )
+    want = ctx.sql(
+        "select name, avg(amount) as a from sales join regions "
+        "on region = id group by name order by name desc"
+    ).collect().to_pandas()
+    pd.testing.assert_frame_equal(out, want)
+    # schema() reports without executing
+    s = ctx.table("sales").schema()
+    assert s.names == ["region", "amount", "qty"]
+
+
+def test_union_distinct_where(ctx):
+    a = ctx.table("sales").select("region").filter(col("region") < lit(2))
+    b = ctx.table("sales").select("region").where(col("region") >= lit(1))
+    u = a.union(b).sort("region").collect().to_pandas()
+    assert u.region.tolist() == [0, 1, 2, 3, 4]
+    ua = a.union(b, all=True).collect()
+    assert ua.num_rows > 5
+
+
+def test_read_csv_roundtrip(ctx, tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("k,v\n1,2.5\n2,3.5\n1,4.0\n")
+    df = ctx.read_csv(str(p)).aggregate([col("k")], [F.sum("v").alias("s")])
+    got = df.sort("k").collect().to_pandas()
+    assert got.k.tolist() == [1, 2]
+    np.testing.assert_allclose(got.s.tolist(), [6.5, 3.5])
+
+
+def test_builder_errors(ctx):
+    with pytest.raises(PlanError):
+        ctx.table("sales").join(
+            ctx.table("regions"), (["region"], ["id"]), how="sideways"
+        )
+    with pytest.raises(PlanError):
+        ctx.sql("show tables").select("x")  # constant frame
+
+
+def test_remote_dataframe_builder(tmp_path):
+    """The same builder executes through the cluster path (standalone
+    scheduler+executor in-process)."""
+    from ballista_tpu.client.context import BallistaContext
+
+    ctx = BallistaContext.standalone()
+    try:
+        rng = np.random.default_rng(3)
+        ctx.register_table(
+            "t",
+            pa.table(
+                {
+                    "g": pa.array(rng.integers(0, 4, 200)),
+                    "v": pa.array(rng.uniform(0, 1, 200)),
+                }
+            ),
+        )
+        out = (
+            ctx.table("t")
+            .filter(col("v") > lit(0.25))
+            .aggregate([col("g")], [F.count_star().alias("n")])
+            .sort("g")
+            .collect()
+            .to_pandas()
+        )
+        want = ctx.sql(
+            "select g, count(*) as n from t where v > 0.25 "
+            "group by g order by g"
+        ).collect().to_pandas()
+        pd.testing.assert_frame_equal(out, want)
+    finally:
+        ctx.close()
