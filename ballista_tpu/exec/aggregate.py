@@ -313,6 +313,10 @@ class HashAggregateExec(ExecutionPlan):
     mode='final' merges partial outputs into final values (single output
     partition unless fed by a hash repartition)."""
 
+    # Max per-batch partial states held live before an incremental fold
+    # (see _execute_partial): bounds HBM at wide cardinalities.
+    _FOLD_WIDTH = 4
+
     def __init__(
         self,
         input: ExecutionPlan,
@@ -586,6 +590,24 @@ class HashAggregateExec(ExecutionPlan):
 
         partials: list[DeviceBatch] = []
         site = self.display()
+        merge_ops = [s.op.merge_op for s in self.spec.slots]
+
+        def fold(states: list[DeviceBatch]) -> DeviceBatch:
+            # slice states down to a learned capacity first (they are
+            # front-compacted), keeping the fold's row count proportional
+            # to actual groups, not capacity
+            states = self._slice_states(states, ctx, site, partition)
+            return self._run_group_agg(
+                concat_batches(states), merge_ops, n_groups, cap,
+                from_state=True, ctx=ctx, site=site + "|fold",
+            )
+
+        # Fold incrementally: a wide-cardinality aggregate's per-batch
+        # states are capacity-sized device arrays, and holding one per
+        # input batch OOMs HBM at scale (SF=10 lineitem = ~30 batches x a
+        # multi-M-row group capacity blew a 16GB chip). Folding every few
+        # batches bounds live states to _FOLD_WIDTH at the cost of
+        # re-merging already-folded groups (merge ops are associative).
         for b in pre.execute(partition, ctx):
             with self.metrics.time("agg_time"):
                 partials.append(
@@ -594,23 +616,18 @@ class HashAggregateExec(ExecutionPlan):
                         site=site,
                     )
                 )
+                if len(partials) >= self._FOLD_WIDTH:
+                    partials = [fold(partials)]
             self.metrics.add("input_batches")
         if not partials:
             return
         if len(partials) == 1:
             yield partials[0]
             return
-        # fold this partition's partials once more (merge ops) to bound
-        # shuffle volume; states are front-compacted, so first slice them
-        # down to a learned capacity (re-bucketing for free) to keep the
-        # fold's row count proportional to actual groups, not capacity
-        partials = self._slice_states(partials, ctx, site, partition)
-        merged = concat_batches(partials)
-        merge_ops = [s.op.merge_op for s in self.spec.slots]
-        yield self._run_group_agg(
-            merged, merge_ops, n_groups, cap, from_state=True, ctx=ctx,
-            site=site + "|fold",
-        )
+        # final fold of this partition's remaining states (bounds shuffle
+        # volume: one folded state leaves the partition)
+        with self.metrics.time("agg_time"):
+            yield fold(partials)
 
     def _scalar_state(self, b: DeviceBatch) -> DeviceBatch:
         val_cols, val_nulls = [], []

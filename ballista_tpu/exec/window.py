@@ -1,13 +1,22 @@
-"""Ranking window operator: ROW_NUMBER / RANK / DENSE_RANK.
+"""Window operator: ranking, aggregates over frames, and LAG/LEAD.
 
-The DataFusion WindowAggExec role, restricted to ranking functions (no
-frames, no argument-taking windows). TPU-native design: sort by (partition
-keys, order keys) via the cached sort passes, then ONE cached jitted
-finisher per (shape, function) computes the ranks on the sorted rows from
-segment-boundary flags (the same changed/cumsum machinery the sort-based
-aggregate uses) and scatters them back to the ORIGINAL row positions
-through the permutation — the operator appends columns without reordering
-its input. Window expressions sharing identical sort keys share one sort.
+The DataFusion WindowAggExec role (ref ballista.proto:531 WindowAggExecNode
+with PhysicalWindowExprNode + WindowFrame, ballista.proto:352-366 /
+datafusion.proto:236-277). TPU-native design: sort by (partition keys,
+order keys) via the cached sort passes, then ONE cached jitted finisher
+per (shape, function, frame) computes the whole output column on the
+sorted rows and scatters it back to the ORIGINAL row positions through
+the permutation — the operator appends columns without reordering its
+input. Window expressions sharing identical sort keys share one sort.
+
+Aggregates over frames reduce by PREFIX SUMS, not per-row loops: on the
+sorted rows, sum over any [lo, hi] row window is cs[hi] - cs[lo-1]
+(float prefixes ride the blocked triangular-matmul path from
+ops/aggregate — no data-dependent control flow, all gathers are n-sized
+vector ops). ROWS frames clamp per-row bounds to the partition;
+RANGE frames snap to peer-group edges. MIN/MAX over running frames use a
+segmented Hillis-Steele doubling scan (log2(n) masked shifts); bounded
+ROWS frames for MIN/MAX are rejected (no prefix trick exists).
 """
 
 from __future__ import annotations
@@ -90,6 +99,203 @@ def _rank_program(
     return jax.jit(f)
 
 
+def _changed_of(cols, nulls, cap):
+    changed = jnp.zeros(cap, dtype=bool).at[0].set(True)
+    for col, nm in zip(cols, nulls):
+        zc = col if nm is None else jnp.where(nm, jnp.zeros_like(col), col)
+        changed = changed | jnp.concatenate(
+            [jnp.ones(1, dtype=bool), zc[1:] != zc[:-1]]
+        )
+        if nm is not None:
+            changed = changed | jnp.concatenate(
+                [jnp.ones(1, dtype=bool), nm[1:] != nm[:-1]]
+            )
+    return changed
+
+
+def _region_edges(changed, cap):
+    """Per-row start and end (inclusive) of the region the row is in,
+    given boundary markers. Start: running max of marked indices. End:
+    next marker minus one (flip/cummin trick)."""
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    start = jax.lax.cummax(jnp.where(changed, idx, 0))
+    nxt = jnp.flip(jax.lax.cummin(jnp.flip(jnp.where(changed, idx, cap))))
+    end = jnp.concatenate([nxt[1:], jnp.full(1, cap, jnp.int32)]) - 1
+    return start, end
+
+
+def _seg_running_minmax(v, ps, is_min: bool):
+    """Segmented prefix min/max: Hillis-Steele doubling with a
+    partition-start guard (the unrolled-associative-scan alternative takes
+    minutes to compile at these lengths)."""
+    cap = v.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    steps = max(1, (cap - 1).bit_length())
+
+    def body(k, v):
+        off = jnp.left_shift(jnp.int32(1), k)
+        prev = jnp.roll(v, off)
+        ok = idx - off >= ps
+        merged = jnp.minimum(v, prev) if is_min else jnp.maximum(v, prev)
+        return jnp.where(ok, merged, v)
+
+    return jax.lax.fori_loop(0, steps, body, v)
+
+
+@functools.lru_cache(maxsize=None)
+def _agg_window_program(
+    fname: str,
+    frame_key,  # None | (units, st, sn, et, en)
+    has_order: bool,
+    part_nulls: tuple,
+    order_nulls: tuple,
+    arg_dtype: str,
+    arg_has_null: bool,
+    out_dtype: str,
+    offset: int,
+    cap: int,
+):
+    """Aggregate / lag / lead window finisher on SORTED rows. Returns the
+    output column and its null mask at ORIGINAL row positions."""
+
+    def f(part_cols, part_nmasks, order_cols, order_nmasks,
+          arg, arg_nmask, valid_sorted, perm):
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        part_changed = _changed_of(part_cols, part_nmasks, cap)
+        # the dead tail (invalid rows sort last) forms its own region so
+        # live frames never cross into it; dead outputs are masked anyway
+        part_changed = part_changed | jnp.concatenate(
+            [jnp.zeros(1, bool), valid_sorted[1:] != valid_sorted[:-1]]
+        )
+        ps, pe = _region_edges(part_changed, cap)
+
+        live = valid_sorted if arg_nmask is None else (
+            valid_sorted & ~arg_nmask
+        )
+
+        if fname in ("lag", "lead"):
+            src = idx - offset if fname == "lag" else idx + offset
+            ok = (src >= ps) & (src <= pe) & valid_sorted
+            srcc = jnp.clip(src, 0, cap - 1)
+            vals = arg[srcc]
+            nulls = ~ok
+            if arg_nmask is not None:
+                nulls = nulls | arg_nmask[srcc]
+            out_vals = jnp.where(nulls, jnp.zeros_like(vals), vals)
+            return (
+                jnp.zeros(cap, vals.dtype).at[perm].set(
+                    out_vals, unique_indices=True
+                ),
+                jnp.zeros(cap, bool).at[perm].set(
+                    nulls, unique_indices=True
+                ),
+            )
+
+        # frame bounds [lo, hi] in sorted row space
+        if frame_key is None:
+            if has_order:
+                # SQL default: RANGE UNBOUNDED PRECEDING .. CURRENT ROW
+                peer_changed = part_changed | _changed_of(
+                    order_cols, order_nmasks, cap
+                )
+                _, peer_end = _region_edges(peer_changed, cap)
+                lo, hi = ps, jnp.minimum(peer_end, pe)
+            else:
+                lo, hi = ps, pe
+        else:
+            units, st, sn, et, en = frame_key
+            if units == "rows":
+                lo = {
+                    "up": ps,
+                    "p": jnp.maximum(idx - sn, ps),
+                    "cur": idx,
+                    "f": jnp.minimum(idx + sn, pe + 1),
+                }[st]
+                hi = {
+                    "p": jnp.maximum(idx - en, ps - 1),
+                    "cur": idx,
+                    "f": jnp.minimum(idx + en, pe),
+                    "uf": pe,
+                }[et]
+            else:  # range: peer-group granularity (offset ranges rejected
+                # at plan time)
+                peer_changed = part_changed | _changed_of(
+                    order_cols, order_nmasks, cap
+                )
+                peer_start, peer_end = _region_edges(peer_changed, cap)
+                lo = ps if st == "up" else peer_start
+                hi = pe if et == "uf" else jnp.minimum(peer_end, pe)
+
+        acc_t = jnp.dtype(arg_dtype)
+        if fname in ("sum", "avg", "count"):
+            if jnp.issubdtype(acc_t, jnp.floating) or fname == "avg":
+                acc_t = jnp.dtype(jnp.float64)
+            else:
+                acc_t = jnp.dtype(jnp.int64)
+            contrib = jnp.where(live, arg, jnp.zeros_like(arg)).astype(acc_t)
+            from ballista_tpu.ops.aggregate import _prefix_sum_2d
+
+            cs = _prefix_sum_2d(contrib[:, None])[:, 0]
+            cnt_cs = jnp.cumsum(live.astype(jnp.int64))
+
+            hi_c = jnp.clip(hi, 0, cap - 1)
+            lo_c = jnp.clip(lo, 0, cap - 1)
+            lo_prev = jnp.clip(lo_c - 1, 0, cap - 1)
+            nonempty = hi >= lo
+
+            def seg(cs1d, zero):
+                pre = jnp.where(lo_c > 0, cs1d[lo_prev], zero)
+                return jnp.where(nonempty, cs1d[hi_c] - pre, zero)
+
+            cnt = seg(cnt_cs, jnp.zeros((), jnp.int64))
+            if fname == "count":
+                vals = cnt
+                nulls = None
+            elif fname == "avg":
+                s = seg(cs, jnp.zeros((), acc_t))
+                vals = s / jnp.maximum(cnt, 1).astype(jnp.float64)
+                nulls = cnt == 0
+            else:
+                vals = seg(cs, jnp.zeros((), acc_t))
+                nulls = cnt == 0
+        else:  # min / max — frames start at UNBOUNDED PRECEDING (plan-
+            # validated), so the value at the frame's END row of the
+            # segmented running scan IS the frame reduction
+            from ballista_tpu.ops.aggregate import _max_ident, _min_ident
+
+            ident = _max_ident(arg.dtype) if fname == "min" else _min_ident(
+                arg.dtype
+            )
+            masked = jnp.where(live, arg, ident)
+            run = _seg_running_minmax(masked, ps, fname == "min")
+            hi_c = jnp.clip(hi, 0, cap - 1)
+            vals = run[hi_c]
+            cnt_cs = jnp.cumsum(live.astype(jnp.int64))
+            pre = jnp.where(
+                ps > 0, cnt_cs[jnp.clip(ps - 1, 0, cap - 1)], 0
+            )
+            # empty frame (an end bound of N PRECEDING before the
+            # partition start) or no live rows in it -> NULL
+            nulls = (hi < ps) | ((cnt_cs[hi_c] - pre) == 0)
+            vals = jnp.where(nulls, jnp.zeros_like(vals), vals)
+
+        out_t = jnp.dtype(out_dtype)
+        vals = vals.astype(out_t)
+        out_vals = jnp.zeros(cap, out_t).at[perm].set(
+            vals, unique_indices=True
+        )
+        out_nulls = (
+            None
+            if nulls is None
+            else jnp.zeros(cap, bool).at[perm].set(
+                nulls, unique_indices=True
+            )
+        )
+        return out_vals, out_nulls
+
+    return jax.jit(f)
+
+
 class WindowExec(ExecutionPlan):
     """Appends one INT64 rank column per window expression. Gathers ALL
     input partitions (a ranking window needs every row of a partition in
@@ -103,18 +309,61 @@ class WindowExec(ExecutionPlan):
         ins = input.schema()
         self._schema = Schema(
             list(ins.fields)
-            + [Field(n, DataType.INT64, False) for n in self.names]
+            + [
+                Field(n, w.data_type(ins), w.nullable(ins))
+                for n, w in zip(self.names, self.window_exprs)
+            ]
         )
         # resolve key columns now (planner guarantees column refs);
         # nulls_first defaults to the engine's Sort convention
         # (FIRST for DESC, LAST for ASC)
         self._keys: list[tuple[tuple[int, ...], tuple[SortKey, ...]]] = []
+        self._args: list[int | None] = []  # arg column index; -1 = literal
+        self._arg_lits: list = []
         for w in self.window_exprs:
             for e in list(w.partition_by) + [e for e, _, _ in w.order_by]:
                 if not isinstance(e, L.Column):
                     raise PlanError(
                         "window PARTITION BY / ORDER BY must be columns "
                         "(project expressions first)"
+                    )
+            if w.arg is None:
+                self._args.append(None)
+                self._arg_lits.append(None)
+            elif isinstance(w.arg, L.Column):
+                ai = L.resolve_field_index(ins, w.arg.cname)
+                if ins.fields[ai].dtype == DataType.STRING:
+                    raise PlanError(
+                        "window functions over STRING columns are not "
+                        "supported yet"
+                    )
+                self._args.append(ai)
+                self._arg_lits.append(None)
+            elif isinstance(w.arg, L.Literal):
+                if not isinstance(w.arg.value, (int, float, bool)):
+                    raise PlanError(
+                        "window function literal arguments must be numeric"
+                    )
+                self._args.append(-1)
+                self._arg_lits.append(w.arg)
+            else:
+                raise PlanError(
+                    "window function arguments must be columns "
+                    "(project expressions first)"
+                )
+            fr = w.frame
+            if fr is not None:
+                if fr.units == "range" and (
+                    fr.start_type in ("p", "f") or fr.end_type in ("p", "f")
+                ):
+                    raise PlanError(
+                        "RANGE frames with numeric offsets are not "
+                        "supported (use ROWS)"
+                    )
+                if w.fname in ("min", "max") and fr.start_type != "up":
+                    raise PlanError(
+                        "MIN/MAX window frames must start at UNBOUNDED "
+                        "PRECEDING (no prefix trick for sliding frames)"
                     )
             self._keys.append(
                 (
@@ -163,7 +412,10 @@ class WindowExec(ExecutionPlan):
         out_cols = list(b.columns)
         out_nulls = list(b.nulls)
         perm_cache: dict = {}  # shared sort for identical key sets
-        for w, (pk, ok) in zip(self.window_exprs, self._keys):
+        for w, (pk, ok), argi, arg_lit, field in zip(
+            self.window_exprs, self._keys, self._args, self._arg_lits,
+            self._schema.fields[len(b.schema):],
+        ):
             sk = tuple(SortKey(col=i, ascending=True) for i in pk) + ok
             perm = perm_cache.get(sk)
             if perm is None:
@@ -181,22 +433,70 @@ class WindowExec(ExecutionPlan):
 
             part_pairs = [gathered(i) for i in pk]
             order_pairs = [gathered(k.col) for k in ok]
-            prog = _rank_program(
+            if w.fname in ("row_number", "rank", "dense_rank"):
+                prog = _rank_program(
+                    tuple(b.nulls[i] is not None for i in pk),
+                    tuple(b.nulls[k.col] is not None for k in ok),
+                    w.fname,
+                    b.capacity,
+                )
+                with self.metrics.time("rank_time"):
+                    vals = prog(
+                        [c for c, _ in part_pairs],
+                        [m for _, m in part_pairs],
+                        [c for c, _ in order_pairs],
+                        [m for _, m in order_pairs],
+                        perm,
+                    )
+                out_cols.append(vals)
+                out_nulls.append(None)
+                continue
+
+            if argi == -1:  # literal argument (COUNT(*) counts frame rows)
+                import numpy as np
+
+                v = arg_lit.value
+                arg_col = jnp.full(
+                    b.capacity, v,
+                    jnp.asarray(np.asarray(v)).dtype,
+                )
+                arg_null = None
+            else:
+                arg_col, arg_null = gathered(argi)
+            valid_sorted = take(b.valid, perm)
+            frame_key = (
+                None
+                if w.frame is None
+                else (
+                    w.frame.units, w.frame.start_type, w.frame.start_n,
+                    w.frame.end_type, w.frame.end_n,
+                )
+            )
+            prog = _agg_window_program(
+                w.fname,
+                frame_key,
+                bool(ok),
                 tuple(b.nulls[i] is not None for i in pk),
                 tuple(b.nulls[k.col] is not None for k in ok),
-                w.fname,
+                str(arg_col.dtype),
+                arg_null is not None,
+                str(jnp.dtype(field.dtype.to_np())),
+                w.offset,
                 b.capacity,
             )
             with self.metrics.time("rank_time"):
-                vals = prog(
+                vals, nulls = prog(
                     [c for c, _ in part_pairs],
                     [m for _, m in part_pairs],
                     [c for c, _ in order_pairs],
                     [m for _, m in order_pairs],
+                    arg_col,
+                    arg_null,
+                    valid_sorted,
                     perm,
                 )
             out_cols.append(vals)
-            out_nulls.append(None)
+            out_nulls.append(nulls)
         yield DeviceBatch(
             schema=self._schema,
             columns=tuple(out_cols),

@@ -653,20 +653,75 @@ class Wildcard(Expr):
         return "*"
 
 
+@dataclasses.dataclass(frozen=True)
+class WindowFrame:
+    """``ROWS/RANGE BETWEEN <start> AND <end>`` (ref WindowFrame,
+    datafusion.proto:236-277). Bound types: ``up`` unbounded preceding,
+    ``p`` n preceding, ``cur`` current row, ``f`` n following, ``uf``
+    unbounded following."""
+
+    units: str  # "rows" | "range"
+    start_type: str = "up"
+    start_n: int = 0
+    end_type: str = "cur"
+    end_n: int = 0
+
+    _ORDER = {"up": 0, "p": 1, "cur": 2, "f": 3, "uf": 4}
+
+    def __post_init__(self):
+        if self.units not in ("rows", "range"):
+            raise PlanError(f"bad window frame units {self.units!r}")
+        for t in (self.start_type, self.end_type):
+            if t not in self._ORDER:
+                raise PlanError(f"bad window frame bound {t!r}")
+        after = self._ORDER[self.start_type] > self._ORDER[self.end_type]
+        if self.start_type == self.end_type == "p":
+            after = self.start_n < self.end_n  # larger N precedes = earlier
+        elif self.start_type == self.end_type == "f":
+            after = self.start_n > self.end_n
+        if self.start_type == "uf" or self.end_type == "up" or after:
+            raise PlanError("window frame start after end")
+
+    def describe(self) -> str:
+        def b(t, n):
+            return {
+                "up": "UNBOUNDED PRECEDING",
+                "p": f"{n} PRECEDING",
+                "cur": "CURRENT ROW",
+                "f": f"{n} FOLLOWING",
+                "uf": "UNBOUNDED FOLLOWING",
+            }[t]
+
+        return (
+            f"{self.units.upper()} BETWEEN {b(self.start_type, self.start_n)}"
+            f" AND {b(self.end_type, self.end_n)}"
+        )
+
+
+_RANKING_WINDOW = ("row_number", "rank", "dense_rank")
+_AGG_WINDOW = ("sum", "avg", "min", "max", "count")
+_SHIFT_WINDOW = ("lag", "lead")
+
+
 @dataclasses.dataclass(frozen=True, eq=False)
 class WindowFunction(Expr):
-    """Ranking window function: ``fname() OVER (PARTITION BY ... ORDER BY
-    ...)``. Only ranking functions (row_number/rank/dense_rank) — they
-    need no argument and no frame. Evaluated by the Window plan node, not
-    row-expression compilation."""
+    """Window function: ranking (row_number/rank/dense_rank), aggregate
+    over a frame (sum/avg/min/max/count ... OVER (... ROWS/RANGE ...)),
+    or shift (lag/lead). Evaluated by the Window plan node, not
+    row-expression compilation. ref: PhysicalWindowExprNode + WindowFrame
+    (ballista.proto:352-366, datafusion.proto:236-277)."""
 
     fname: str
     partition_by: tuple[Expr, ...]
     # (expr, ascending, nulls_first) — nulls_first None = SQL default
     # (FIRST for DESC, LAST for ASC, matching the engine's Sort)
     order_by: tuple[tuple[Expr, bool, bool | None], ...]
+    arg: Expr | None
+    frame: WindowFrame | None
+    offset: int  # lag/lead distance
 
-    def __init__(self, fname, partition_by, order_by):
+    def __init__(self, fname, partition_by, order_by, arg=None, frame=None,
+                 offset=1):
         object.__setattr__(self, "fname", fname)
         object.__setattr__(self, "partition_by", tuple(partition_by))
         object.__setattr__(
@@ -676,27 +731,57 @@ class WindowFunction(Expr):
                 (t[0], t[1], t[2] if len(t) > 2 else None) for t in order_by
             ),
         )
-        if fname not in ("row_number", "rank", "dense_rank"):
+        object.__setattr__(self, "arg", arg)
+        object.__setattr__(self, "frame", frame)
+        object.__setattr__(self, "offset", int(offset))
+        if fname not in _RANKING_WINDOW + _AGG_WINDOW + _SHIFT_WINDOW:
             raise PlanError(f"unsupported window function {fname!r}")
+        if fname in _RANKING_WINDOW:
+            if arg is not None or frame is not None:
+                raise PlanError(f"{fname}() takes no argument and no frame")
+        elif arg is None:
+            raise PlanError(f"{fname}() window requires an argument")
+        if fname in _SHIFT_WINDOW and frame is not None:
+            raise PlanError(f"{fname}() takes no frame")
 
     def data_type(self, schema: Schema) -> DataType:
-        return DataType.INT64
+        if self.fname in _RANKING_WINDOW or self.fname == "count":
+            return DataType.INT64
+        if self.fname == "avg":
+            return DataType.FLOAT64
+        at = self.arg.data_type(schema)
+        if self.fname == "sum":
+            if at.is_integer:
+                return DataType.INT64
+            if at.is_floating:
+                return DataType.FLOAT64
+        return at
 
     def nullable(self, schema: Schema) -> bool:
-        return False
+        # empty frames / shifted-off-partition rows yield NULL
+        return self.fname not in _RANKING_WINDOW + ("count",)
 
     def children(self) -> list[Expr]:
-        return list(self.partition_by) + [e for e, _, _ in self.order_by]
+        kids = list(self.partition_by) + [e for e, _, _ in self.order_by]
+        if self.arg is not None:
+            kids.append(self.arg)
+        return kids
 
     def with_children(self, children: list[Expr]) -> "WindowFunction":
         np_ = len(self.partition_by)
+        no_ = len(self.order_by)
         return WindowFunction(
             self.fname,
             tuple(children[:np_]),
             tuple(
                 (c, asc, nf)
-                for c, (_, asc, nf) in zip(children[np_:], self.order_by)
+                for c, (_, asc, nf) in zip(
+                    children[np_ : np_ + no_], self.order_by
+                )
             ),
+            arg=children[np_ + no_] if self.arg is not None else None,
+            frame=self.frame,
+            offset=self.offset,
         )
 
     def name(self) -> str:
@@ -718,7 +803,15 @@ class WindowFunction(Expr):
                     for e, asc, nf in self.order_by
                 )
             )
-        return f"{self.fname}() OVER ({' '.join(parts)})"
+        if self.frame is not None:
+            parts.append(self.frame.describe())
+        if self.fname in _SHIFT_WINDOW:
+            args = f"{self.arg.name()}, {self.offset}"
+        elif self.arg is not None:
+            args = self.arg.name()
+        else:
+            args = ""
+        return f"{self.fname}({args}) OVER ({' '.join(parts)})"
 
 
 @dataclasses.dataclass(frozen=True, eq=False)

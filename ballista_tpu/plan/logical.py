@@ -316,21 +316,26 @@ class Distinct(LogicalPlan):
 
 @dataclasses.dataclass(frozen=True, eq=False)
 class Window(LogicalPlan):
-    """Appends one INT64 column per ranking window expression (DataFusion
-    WindowAggExec's role, restricted to ranking functions). ``names`` are
-    the appended output column names (the SQL planner's select list then
-    references them as ordinary columns)."""
+    """Appends one column per window expression — ranking, aggregate-over-
+    frame, or lag/lead (DataFusion WindowAggExec's role; ref
+    ballista.proto:531 WindowAggExecNode). ``names`` are the appended
+    output column names (the SQL planner's select list then references
+    them as ordinary columns)."""
 
     input: LogicalPlan
     window_exprs: tuple  # of L.WindowFunction
     names: tuple  # of str, same length
 
     def schema(self) -> Schema:
-        from ballista_tpu.datatypes import DataType, Field
+        from ballista_tpu.datatypes import Field
 
+        ins = self.input.schema()
         return Schema(
-            list(self.input.schema().fields)
-            + [Field(n, DataType.INT64, False) for n in self.names]
+            list(ins.fields)
+            + [
+                Field(n, w.data_type(ins), w.nullable(ins))
+                for n, w in zip(self.names, self.window_exprs)
+            ]
         )
 
     def children(self) -> list[LogicalPlan]:

@@ -594,6 +594,11 @@ def _prune(plan: LogicalPlan, required: set[str] | None) -> LogicalPlan:
             inner_req |= _expr_columns(
                 [e for w in plan.window_exprs for e in w.partition_by]
                 + [e for w in plan.window_exprs for e, _, _ in w.order_by]
+                + [
+                    w.arg
+                    for w in plan.window_exprs
+                    if w.arg is not None
+                ]
             )
         return plan.with_children([_prune(plan.input, inner_req)])
     if isinstance(plan, Union):

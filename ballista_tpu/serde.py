@@ -394,7 +394,7 @@ def logical_to_proto(plan: P.LogicalPlan) -> pb.LogicalPlanNode:
 
 
 def _window_expr_to_proto(w) -> pb.WindowExprNode:
-    return pb.WindowExprNode(
+    node = pb.WindowExprNode(
         fname=w.fname,
         partition_by=[expr_to_proto(e) for e in w.partition_by],
         order_exprs=[expr_to_proto(e) for e, _, _ in w.order_by],
@@ -402,16 +402,48 @@ def _window_expr_to_proto(w) -> pb.WindowExprNode:
         order_nulls=[
             -1 if nf is None else int(nf) for _, _, nf in w.order_by
         ],
+        shift_offset=w.offset,
     )
+    if w.arg is not None:
+        node.arg.CopyFrom(expr_to_proto(w.arg))
+        node.has_arg = True
+    if w.frame is not None:
+        node.frame.CopyFrom(
+            pb.WindowFrameP(
+                units=w.frame.units,
+                start_type=w.frame.start_type,
+                start_n=w.frame.start_n,
+                end_type=w.frame.end_type,
+                end_n=w.frame.end_n,
+            )
+        )
+        node.has_frame = True
+    return node
 
 
 def _window_expr_from_proto(w: pb.WindowExprNode):
+    frame = None
+    if w.has_frame:
+        frame = L.WindowFrame(
+            w.frame.units,
+            w.frame.start_type,
+            int(w.frame.start_n),
+            w.frame.end_type,
+            int(w.frame.end_n),
+        )
     return L.WindowFunction(
         w.fname,
         tuple(expr_from_proto(e) for e in w.partition_by),
         tuple(
             (expr_from_proto(e), asc, None if nf < 0 else bool(nf))
             for e, asc, nf in zip(w.order_exprs, w.order_asc, w.order_nulls)
+        ),
+        arg=expr_from_proto(w.arg) if w.has_arg else None,
+        frame=frame,
+        # the field is meaningful only for shifts — LAG(x, 0) is a valid
+        # explicit zero and must not be conflated with proto default 0
+        offset=(
+            int(w.shift_offset) if w.fname in ("lag", "lead") else 1
         ),
     )
 

@@ -35,6 +35,7 @@ KEYWORDS = {
     "last", "true", "false", "explain", "drop", "if", "partitioned",
     "delimiter", "compression", "analyze", "verbose", "for", "year", "month",
     "day", "describe", "insert", "into", "values", "over", "partition",
+    "rows", "range", "unbounded", "preceding", "following", "current",
 }
 
 _TWO_CHAR_OPS = {"<>", "!=", ">=", "<=", "||"}

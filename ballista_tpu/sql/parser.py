@@ -108,7 +108,8 @@ class Parser:
         if t.kind == Tok.KEYWORD and t.value in (
             "year", "month", "day", "date", "timestamp", "first", "last",
             "location", "tables", "columns", "row", "values", "over",
-            "partition",
+            "partition", "rows", "range", "unbounded", "preceding",
+            "following", "current",
         ):
             return t.value
         raise SqlError(f"expected identifier but found {t.value!r} at offset {t.pos}")
@@ -699,7 +700,31 @@ class Parser:
             if name == "corr" and arg2 is None:
                 raise SqlError("corr() takes two arguments")
             self.expect_punct(")")
+            if self.peek().is_kw("over"):
+                # aggregate window: SUM(x) OVER (... [ROWS/RANGE frame])
+                if name not in ("sum", "avg", "min", "max", "count"):
+                    raise SqlError(
+                        f"{name}() is not supported as a window function"
+                    )
+                if distinct:
+                    raise SqlError("DISTINCT windows are not supported")
+                warg = None if isinstance(arg, L.Wildcard) else arg
+                if name == "count" and warg is None:
+                    warg = L.Literal.infer(1)  # COUNT(*) counts frame rows
+                elif warg is None:
+                    raise SqlError(f"{name}(*) is not valid")
+                return self.parse_over_clause(name, arg=warg)
             return L.AggregateExpr(L.AggFunc(name), arg, distinct, arg2)
+        if name in ("lag", "lead"):
+            arg = self.parse_expr()
+            offset = 1
+            if self.accept_punct(","):
+                t = self.next()
+                if t.kind != Tok.NUMBER:
+                    raise SqlError(f"{name}() offset must be a literal int")
+                offset = int(t.value)
+            self.expect_punct(")")
+            return self.parse_over_clause(name, arg=arg, offset=offset)
         args: list[L.Expr] = []
         if not self.accept_punct(")"):
             args.append(self.parse_expr())
@@ -716,8 +741,11 @@ class Parser:
             name = "substr"
         return L.ScalarFunction(name, tuple(args))
 
-    def parse_over_clause(self, fname: str) -> L.Expr:
-        """``OVER ( [PARTITION BY e, ...] [ORDER BY items] )``."""
+    def parse_over_clause(
+        self, fname: str, arg: L.Expr | None = None, offset: int = 1
+    ) -> L.Expr:
+        """``OVER ( [PARTITION BY e, ...] [ORDER BY items]
+        [ROWS|RANGE <frame>] )``."""
         self.expect_kw("over")
         self.expect_punct("(")
         partition_by: list[L.Expr] = []
@@ -730,5 +758,39 @@ class Parser:
             (item.expr, item.ascending, item.nulls_first)
             for item in self.parse_order_by()
         ]
+        frame = None
+        if self.peek().is_kw("rows", "range"):
+            units = self.next().value
+            if self.accept_kw("between"):
+                st, sn = self.parse_frame_bound()
+                self.expect_kw("and")
+                et, en = self.parse_frame_bound()
+            else:  # shorthand: <bound> = BETWEEN <bound> AND CURRENT ROW
+                st, sn = self.parse_frame_bound()
+                et, en = "cur", 0
+            frame = L.WindowFrame(units, st, sn, et, en)
         self.expect_punct(")")
-        return L.WindowFunction(fname, tuple(partition_by), tuple(order_by))
+        return L.WindowFunction(
+            fname, tuple(partition_by), tuple(order_by), arg=arg,
+            frame=frame, offset=offset,
+        )
+
+    def parse_frame_bound(self) -> tuple[str, int]:
+        if self.accept_kw("unbounded"):
+            if self.accept_kw("preceding"):
+                return "up", 0
+            self.expect_kw("following")
+            return "uf", 0
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return "cur", 0
+        t = self.next()
+        if t.kind != Tok.NUMBER:
+            raise SqlError(
+                f"expected a window frame bound at offset {t.pos}"
+            )
+        n = int(t.value)
+        if self.accept_kw("preceding"):
+            return "p", n
+        self.expect_kw("following")
+        return "f", n

@@ -1,0 +1,274 @@
+"""Aggregate window functions + frames and LAG/LEAD.
+
+Oracle: pandas groupby rolling/expanding/shift. ref wire shape:
+WindowAggExecNode (ballista.proto:531) with PhysicalWindowExprNode +
+WindowFrame (ballista.proto:352-366, datafusion.proto:236-277); this
+engine computes frames by prefix-sum differences on sorted rows
+(exec/window.py).
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.errors import PlanError, SqlError
+from ballista_tpu.exec.context import TpuContext
+
+
+@pytest.fixture(scope="module")
+def setup():
+    r = np.random.default_rng(7)
+    n = 2000
+    t = pa.table(
+        {
+            "g": pa.array(r.integers(0, 15, n).astype(np.int64)),
+            "o": pa.array(r.permutation(n).astype(np.int64)),
+            "v": pa.array(np.round(r.uniform(0, 100, n), 6)),
+            "q": pa.array(r.integers(1, 10, n).astype(np.int64)),
+        }
+    )
+    ctx = TpuContext()
+    ctx.register_table("t", t)
+    df = t.to_pandas()
+    return ctx, df
+
+
+def _run(ctx, sql):
+    return (
+        ctx.sql(sql).collect().to_pandas().sort_values("o").reset_index(
+            drop=True
+        )
+    )
+
+
+def test_running_sum_default_frame(setup):
+    ctx, df = setup
+    got = _run(
+        ctx,
+        "select o, sum(v) over (partition by g order by o) as s from t",
+    )
+    want = df.sort_values(["g", "o"]).copy()
+    want["s"] = want.groupby("g").v.cumsum()
+    want = want.sort_values("o").reset_index(drop=True)
+    np.testing.assert_allclose(got.s.to_numpy(), want.s.to_numpy(), rtol=1e-9)
+
+
+def test_whole_partition_aggregates(setup):
+    ctx, df = setup
+    got = _run(
+        ctx,
+        "select o, sum(v) over (partition by g) as s, "
+        "avg(v) over (partition by g) as a, "
+        "count(*) over (partition by g) as c, "
+        "min(v) over (partition by g) as mn, "
+        "max(v) over (partition by g) as mx from t",
+    )
+    grp = df.groupby("g").v
+    want = df.copy()
+    want["s"] = grp.transform("sum")
+    want["a"] = grp.transform("mean")
+    want["c"] = grp.transform("count")
+    want["mn"] = grp.transform("min")
+    want["mx"] = grp.transform("max")
+    want = want.sort_values("o").reset_index(drop=True)
+    for c in ("s", "a", "mn", "mx"):
+        np.testing.assert_allclose(
+            got[c].to_numpy(), want[c].to_numpy(), rtol=1e-9, err_msg=c
+        )
+    assert got.c.tolist() == want.c.tolist()
+
+
+def test_moving_average_rows_frame(setup):
+    ctx, df = setup
+    got = _run(
+        ctx,
+        "select o, avg(v) over (partition by g order by o "
+        "rows between 2 preceding and current row) as ma, "
+        "sum(q) over (partition by g order by o "
+        "rows between 1 preceding and 1 following) as sq from t",
+    )
+    s = df.sort_values(["g", "o"]).copy()
+    s["ma"] = (
+        s.groupby("g").v.rolling(3, min_periods=1).mean().reset_index(
+            level=0, drop=True
+        )
+    )
+    s["sq"] = (
+        s.groupby("g").q.rolling(3, min_periods=1, center=True)
+        .sum()
+        .reset_index(level=0, drop=True)
+    )
+    want = s.sort_values("o").reset_index(drop=True)
+    np.testing.assert_allclose(got.ma.to_numpy(), want.ma.to_numpy(), rtol=1e-9)
+    np.testing.assert_allclose(got.sq.to_numpy(), want.sq.to_numpy(), rtol=1e-9)
+
+
+def test_running_min_max(setup):
+    ctx, df = setup
+    got = _run(
+        ctx,
+        "select o, min(v) over (partition by g order by o) as mn, "
+        "max(v) over (partition by g order by o "
+        "rows unbounded preceding) as mx from t",
+    )
+    s = df.sort_values(["g", "o"]).copy()
+    s["mn"] = s.groupby("g").v.cummin()
+    s["mx"] = s.groupby("g").v.cummax()
+    want = s.sort_values("o").reset_index(drop=True)
+    np.testing.assert_allclose(got.mn.to_numpy(), want.mn.to_numpy(), rtol=1e-9)
+    np.testing.assert_allclose(got.mx.to_numpy(), want.mx.to_numpy(), rtol=1e-9)
+
+
+def test_lag_lead(setup):
+    ctx, df = setup
+    got = _run(
+        ctx,
+        "select o, lag(v) over (partition by g order by o) as l1, "
+        "lead(v, 2) over (partition by g order by o) as l2 from t",
+    )
+    s = df.sort_values(["g", "o"]).copy()
+    s["l1"] = s.groupby("g").v.shift(1)
+    s["l2"] = s.groupby("g").v.shift(-2)
+    want = s.sort_values("o").reset_index(drop=True)
+    for c in ("l1", "l2"):
+        g = got[c].to_numpy()
+        w = want[c].to_numpy()
+        assert np.array_equal(np.isnan(g), np.isnan(w)), c
+        np.testing.assert_allclose(
+            g[~np.isnan(g)], w[~np.isnan(w)], rtol=1e-9, err_msg=c
+        )
+
+
+def test_rows_following_only_frame(setup):
+    ctx, df = setup
+    got = _run(
+        ctx,
+        "select o, sum(v) over (partition by g order by o "
+        "rows between 1 following and 2 following) as s from t",
+    )
+    s = df.sort_values(["g", "o"]).copy()
+
+    def f(grp):
+        v = grp.to_numpy()
+        out = np.full(len(v), np.nan)
+        for i in range(len(v)):
+            w = v[i + 1 : i + 3]
+            if len(w):
+                out[i] = w.sum()
+        return pd.Series(out, index=grp.index)
+
+    s["s"] = s.groupby("g").v.apply(f).reset_index(level=0, drop=True)
+    want = s.sort_values("o").reset_index(drop=True)
+    g = got.s.to_numpy()
+    w = want.s.to_numpy()
+    assert np.array_equal(np.isnan(g), np.isnan(w))
+    np.testing.assert_allclose(g[~np.isnan(g)], w[~np.isnan(w)], rtol=1e-9)
+
+
+def test_range_frame_peers(setup):
+    ctx, df = setup
+    # duplicate order values -> peer groups share the running value
+    got = _run(
+        ctx,
+        "select o, sum(v) over (partition by g order by q) as s from t",
+    )
+    s = df.sort_values(["g", "q"], kind="stable").copy()
+    # RANGE up..current: every peer (equal q) gets the peer-group total
+    s["s"] = s.groupby("g").v.cumsum()
+    peer_tot = s.groupby(["g", "q"]).s.transform("max")
+    s["s"] = peer_tot
+    want = s.sort_values("o").reset_index(drop=True)
+    np.testing.assert_allclose(got.s.to_numpy(), want.s.to_numpy(), rtol=1e-9)
+
+
+def test_window_with_nulls(setup):
+    ctx, _ = setup
+    t = pa.table(
+        {
+            "g": pa.array([0, 0, 0, 1, 1], type=pa.int64()),
+            "o": pa.array([0, 1, 2, 3, 4], type=pa.int64()),
+            "v": pa.array([1.0, None, 3.0, None, None]),
+        }
+    )
+    ctx.register_table("tn", t)
+    got = (
+        ctx.sql(
+            "select o, sum(v) over (partition by g order by o) as s, "
+            "count(v) over (partition by g order by o) as c from tn"
+        )
+        .collect()
+        .to_pandas()
+        .sort_values("o")
+    )
+    # NULL v rows don't contribute; all-NULL partition -> NULL sum, count 0
+    np.testing.assert_allclose(
+        got.s.to_numpy()[:3], [1.0, 1.0, 4.0], rtol=1e-12
+    )
+    assert np.isnan(got.s.to_numpy()[3:]).all()
+    assert got.c.tolist() == [1, 1, 2, 0, 0]
+    ctx.deregister_table("tn")
+
+
+def test_frame_errors(setup):
+    ctx, _ = setup
+    with pytest.raises(PlanError):
+        ctx.sql(
+            "select min(v) over (partition by g order by o "
+            "rows between 2 preceding and current row) as m from t"
+        ).collect()
+    with pytest.raises((PlanError, SqlError)):
+        ctx.sql(
+            "select sum(v) over (order by o "
+            "range between 2 preceding and current row) as m from t"
+        ).collect()
+
+
+def test_serde_roundtrip_window_aggregates(setup):
+    ctx, _ = setup
+    from ballista_tpu.serde import logical_from_proto, logical_to_proto
+
+    logical = ctx.sql_to_logical(
+        "select o, sum(v) over (partition by g order by o "
+        "rows between 3 preceding and 1 following) as s, "
+        "lag(v, 2) over (partition by g order by o) as l from t"
+    )
+    rt = logical_from_proto(logical_to_proto(logical))
+    assert rt.display() == logical.display()
+
+
+def test_min_empty_frame_is_null(setup):
+    ctx, _ = setup
+    t = pa.table(
+        {
+            "o": pa.array([0, 1, 2], type=pa.int64()),
+            "v": pa.array([5.0, 3.0, 9.0]),
+        }
+    )
+    ctx.register_table("tm", t)
+    got = (
+        ctx.sql(
+            "select o, min(v) over (order by o rows between unbounded "
+            "preceding and 1 preceding) as m from tm"
+        )
+        .collect()
+        .to_pandas()
+        .sort_values("o")
+    )
+    m = got.m.to_numpy()
+    assert np.isnan(m[0])  # empty frame for the first row
+    np.testing.assert_allclose(m[1:], [5.0, 3.0])
+    ctx.deregister_table("tm")
+
+
+def test_frame_start_after_end_rejected(setup):
+    ctx, _ = setup
+    for frame in (
+        "rows between current row and 1 preceding",
+        "rows between 1 preceding and 3 preceding",
+        "rows between 3 following and 1 following",
+    ):
+        with pytest.raises(PlanError):
+            ctx.sql(
+                f"select sum(v) over (order by o {frame}) as s from t"
+            ).collect()
