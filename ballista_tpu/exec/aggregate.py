@@ -113,6 +113,34 @@ def decompose_aggregates(
             )
         a = e
         out_dtype = a.data_type(input_schema)
+        if isinstance(a, L.PercentileExpr):
+            raise PlanError(
+                "percentile aggregates must be split out by the optimizer "
+                "(split_percentiles) before physical planning"
+            )
+        if isinstance(a, L.UdafExpr):
+            from ballista_tpu.plugin import lookup_udaf
+
+            udaf = lookup_udaf(a.uname)
+            idxs = []
+            for suffix, op_s, has_transform in udaf.states:
+                arg = a.arg
+                if has_transform:
+                    arg = L.ScalarFunction(
+                        f"__udaf_{a.uname}_{suffix}", (arg,)
+                    )
+                op = {
+                    "sum": AggOp.SUM, "count": AggOp.COUNT,
+                    "min": AggOp.MIN, "max": AggOp.MAX,
+                }[op_s]
+                src = arg_slot(arg)
+                idxs.append(
+                    slot_for(op, src, f"{a.name()}#{suffix}")
+                )
+            finals.append(
+                (a.name(), out_dtype, tuple(idxs), f"udaf:{a.uname}")
+            )
+            continue
         if a.func == L.AggFunc.AVG:
             src = arg_slot(a.arg)
             i1 = slot_for(AggOp.SUM, src, f"{a.name()}#sum")
@@ -284,6 +312,20 @@ def finalize_state(
             vals, nl = _stat_final(
                 lambda i: state.columns[n_groups + i], idxs, kind
             )
+        elif kind.startswith("udaf:"):
+            from ballista_tpu.plugin import lookup_udaf
+
+            udaf = lookup_udaf(kind[5:])
+            vals = udaf.finalize(
+                *(state.columns[n_groups + i] for i in idxs)
+            )
+            # NULL for groups whose count state saw no live rows; without
+            # a count state the finalize result stands as computed
+            nl = None
+            for (suffix, op_s, _), i in zip(udaf.states, idxs):
+                if op_s == "count":
+                    nl = state.columns[n_groups + i] == 0
+                    break
         else:
             vals = state.columns[n_groups + idxs[0]]
             nl = state.nulls[n_groups + idxs[0]]
@@ -610,6 +652,9 @@ class HashAggregateExec(ExecutionPlan):
         # re-merging already-folded groups (merge ops are associative).
         for b in pre.execute(partition, ctx):
             with self.metrics.time("agg_time"):
+                # per-batch states come out at min(cap, batch capacity)
+                # (_run_group_agg clamps internally) — a batch of N rows
+                # holds at most N groups
                 partials.append(
                     self._run_group_agg(
                         b, ops, n_groups, cap, from_state=False, ctx=ctx,

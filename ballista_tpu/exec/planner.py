@@ -134,6 +134,15 @@ class PhysicalPlanner:
             return ProjectionExec(self._plan(node.input), list(node.exprs))
         if isinstance(node, P.Filter):
             return FilterExec(self._plan(node.input), node.predicate)
+        if isinstance(node, P.Percentile):
+            from ballista_tpu.exec.percentile import PercentileExec
+
+            return PercentileExec(
+                self._plan(node.input),
+                node.group_exprs,
+                node.group_names,
+                node.requests,
+            )
         if isinstance(node, P.Window):
             from ballista_tpu.exec.window import WindowExec
 

@@ -864,6 +864,79 @@ class AggregateExpr(Expr):
         return self.name()
 
 
+@dataclasses.dataclass(frozen=True, eq=False)
+class PercentileExpr(AggregateExpr):
+    """``approx_percentile_cont(x, q)`` / ``median(x)``. Holistic (not
+    algebraic): the optimizer splits it out of Aggregate nodes into a
+    dedicated Percentile plan node (sort-based exact selection — sorting
+    is cheap on this engine, so 'approx' actually computes the exact
+    continuous percentile; name kept for reference-API parity,
+    DataFusion's approx_percentile_cont)."""
+
+    q: float = 0.5
+
+    def __init__(self, arg: Expr, q: float):
+        object.__setattr__(self, "func", AggFunc.SUM)  # unused marker
+        object.__setattr__(self, "arg", arg)
+        object.__setattr__(self, "distinct", False)
+        object.__setattr__(self, "arg2", None)
+        if not (0.0 <= q <= 1.0):
+            raise PlanError(f"percentile {q} outside [0, 1]")
+        object.__setattr__(self, "q", float(q))
+
+    def data_type(self, schema: Schema) -> DataType:
+        return DataType.FLOAT64
+
+    def nullable(self, schema: Schema) -> bool:
+        return True  # group with no non-null values
+
+    def name(self) -> str:
+        return f"APPROX_PERCENTILE_CONT({self.arg.name()}, {self.q:g})"
+
+    def children(self) -> list[Expr]:
+        return [self.arg]
+
+    def with_children(self, children: list[Expr]) -> "PercentileExpr":
+        return PercentileExpr(children[0], self.q)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class UdafExpr(AggregateExpr):
+    """A registered aggregate UDF call (ref python/src/udaf.rs). Subclasses
+    AggregateExpr so the planner's aggregate discovery and the two-phase
+    decomposition treat it like any built-in; the wire format carries only
+    the name (both ends load the same plugin dir, like scalar UDFs)."""
+
+    uname: str = ""
+
+    def __init__(self, uname: str, arg: Expr):
+        object.__setattr__(self, "func", AggFunc.SUM)  # unused marker
+        object.__setattr__(self, "arg", arg)
+        object.__setattr__(self, "distinct", False)
+        object.__setattr__(self, "arg2", None)
+        object.__setattr__(self, "uname", uname.lower())
+
+    def data_type(self, schema: Schema) -> DataType:
+        from ballista_tpu.plugin import lookup_udaf
+
+        rt = lookup_udaf(self.uname).return_type
+        if rt == "same":
+            return self.arg.data_type(schema)
+        return rt
+
+    def nullable(self, schema: Schema) -> bool:
+        return True
+
+    def name(self) -> str:
+        return f"{self.uname}({self.arg.name()})"
+
+    def children(self) -> list[Expr]:
+        return [self.arg]
+
+    def with_children(self, children: list[Expr]) -> "UdafExpr":
+        return UdafExpr(self.uname, children[0])
+
+
 # Scalar function registry: name -> (return-type rule, min arity, max arity).
 # Type rules: "same" (arg 0's type), or a fixed DataType.
 _SCALAR_FUNCS: dict[str, tuple[object, int, int]] = {

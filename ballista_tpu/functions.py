@@ -67,3 +67,10 @@ def var_pop(e) -> L.AggregateExpr:
 
 def corr(a, b) -> L.AggregateExpr:
     return L.AggregateExpr(L.AggFunc.CORR, _wrap(a), arg2=_wrap(b))
+
+
+def udaf(name: str, e) -> L.Expr:
+    """Call a registered aggregate UDF (plugin register_udaf) by name."""
+    from ballista_tpu.expr.logical import UdafExpr
+
+    return UdafExpr(name, _wrap(e))

@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 from enum import Enum
 
-from ballista_tpu.datatypes import Field, Schema
+from ballista_tpu.datatypes import DataType, Field, Schema
 from ballista_tpu.errors import PlanError
 from ballista_tpu.expr import logical as L
 
@@ -348,6 +348,46 @@ class Window(LogicalPlan):
         return "Window: " + ", ".join(
             f"{n} = {w.name()}" for n, w in zip(self.names, self.window_exprs)
         )
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class Percentile(LogicalPlan):
+    """Holistic percentile aggregate: one row per distinct group-key
+    combination, carrying each requested continuous percentile of its
+    value expression (sort-based exact selection; see exec/percentile.py).
+    Produced by the optimizer's aggregate split — SQL never plans it
+    directly. Output schema: group columns (names given, so the split can
+    use internal names that cannot collide in the re-join) then one
+    FLOAT64 column per (value, q, name) request."""
+
+    input: LogicalPlan
+    group_exprs: tuple[L.Expr, ...]
+    group_names: tuple[str, ...]
+    requests: tuple  # of (value expr, q float, output name)
+
+    def schema(self) -> Schema:
+        ins = self.input.schema()
+        fields = [
+            Field(n, e.data_type(ins), e.nullable(ins))
+            for e, n in zip(self.group_exprs, self.group_names)
+        ]
+        fields += [
+            Field(n, DataType.FLOAT64, True) for _, _, n in self.requests
+        ]
+        return Schema(fields)
+
+    def children(self) -> list[LogicalPlan]:
+        return [self.input]
+
+    def with_children(self, children: list[LogicalPlan]) -> "Percentile":
+        return Percentile(
+            children[0], self.group_exprs, self.group_names, self.requests
+        )
+
+    def describe(self) -> str:
+        g = ", ".join(e.name() for e in self.group_exprs)
+        r = ", ".join(f"{n}=p{q:g}({e.name()})" for e, q, n in self.requests)
+        return f"Percentile: groupBy=[{g}], [{r}]"
 
 
 @dataclasses.dataclass(frozen=True, eq=False)

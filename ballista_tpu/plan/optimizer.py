@@ -32,6 +32,7 @@ from ballista_tpu.plan.logical import (
     JoinType,
     Limit,
     LogicalPlan,
+    Percentile,
     Projection,
     Sort,
     SortExpr,
@@ -53,8 +54,164 @@ def optimize(plan: LogicalPlan) -> LogicalPlan:
     plan = push_down_filters(plan)
     plan = eliminate_cross_joins(plan)
     plan = push_down_filters(plan)
+    plan = split_percentiles(plan)
     plan = prune_columns(plan)
     return plan
+
+
+def split_percentiles(plan: LogicalPlan) -> LogicalPlan:
+    """Aggregate nodes containing holistic percentile expressions split
+    into Aggregate(rest) ⋈ Percentile(...) on the group keys, with a
+    projection restoring the original output schema. The percentile side
+    re-reads the aggregate's input (holistic aggregates cannot share the
+    algebraic partial/merge pipeline); scans are device-cached, so the
+    second pass is cheap for the common grouped-table shape."""
+    kids = [split_percentiles(c) for c in plan.children()]
+    plan = plan.with_children(kids) if kids else plan
+    if not isinstance(plan, Aggregate):
+        return plan
+    percs = [
+        e for e in plan.agg_exprs if isinstance(e, L.PercentileExpr)
+    ]
+    if not percs:
+        return plan
+    rest = tuple(
+        e for e in plan.agg_exprs if not isinstance(e, L.PercentileExpr)
+    )
+    ins = plan.input.schema()
+
+    # NULL group keys are their own group (SQL), but equi-joins never
+    # match NULL — so every join key rides as a (zeroed value, is-null
+    # flag) PAIR, and the Percentile side groups by the same pair.
+    def _zero_lit(dt: DataType) -> L.Literal:
+        zero = {
+            DataType.STRING: "",
+            DataType.BOOL: False,
+            DataType.FLOAT32: 0.0,
+            DataType.FLOAT64: 0.0,
+        }.get(dt, 0)
+        return L.Literal(zero, dt)
+
+    def zeroed(e: L.Expr) -> L.Expr:
+        dt = e.data_type(ins)
+        return L.Case(((L.IsNotNull(e), e),), _zero_lit(dt))
+
+    nullable = [g.nullable(ins) for g in plan.group_exprs]
+    gz = [
+        zeroed(g) if nl else g
+        for g, nl in zip(plan.group_exprs, nullable)
+    ]
+    gflags = [
+        L.IsNull(g) if nl else None
+        for g, nl in zip(plan.group_exprs, nullable)
+    ]
+
+    def key_aliases(prefix: str) -> list[L.Alias]:
+        out = []
+        for i, (z, f) in enumerate(zip(gz, gflags)):
+            out.append(L.Alias(z, f"{prefix}{i}"))
+            if f is not None:
+                out.append(L.Alias(f, f"{prefix}n{i}"))
+        return out
+
+    # one Percentile node per distinct value expression; each piece gets
+    # ITS OWN key column names so chained joins never collide
+    by_val: dict[str, list[L.PercentileExpr]] = {}
+    for e in percs:
+        by_val.setdefault(e.arg.name(), []).append(e)
+    pieces: list[tuple[LogicalPlan, list[str]]] = []
+    out_of: dict[int, str] = {}  # id(perc expr) -> output column name
+    for vi, (vname, group) in enumerate(by_val.items()):
+        p_keys = key_aliases(f"__pg{vi}_")
+        p_key_names = [a.aname for a in p_keys]
+        proj = Projection(
+            plan.input,
+            tuple(p_keys) + (L.Alias(group[0].arg, f"__pv{vi}"),),
+        )
+        reqs = []
+        for j, e in enumerate(group):
+            name = f"__pp{vi}_{j}"
+            out_of[id(e)] = name
+            reqs.append((L.Column(f"__pv{vi}"), e.q, name))
+        pieces.append(
+            (
+                Percentile(
+                    proj,
+                    tuple(L.Column(n) for n in p_key_names),
+                    tuple(p_key_names),
+                    tuple(reqs),
+                ),
+                p_key_names,
+            )
+        )
+
+    def join2(a: LogicalPlan, a_keys: list[str], b: LogicalPlan,
+              b_keys: list[str]):
+        if not plan.group_exprs:
+            return CrossJoin(a, b)  # percentile side is a single row
+        return Join(
+            a, b,
+            tuple(
+                (L.Column(ak), L.Column(gn))
+                for ak, gn in zip(a_keys, b_keys)
+            ),
+            JoinType.INNER,
+        )
+
+    if rest:
+        # base aggregate keeps the ORIGINAL group exprs (real NULLs in
+        # its output keys); a projection adds the null-safe join pair
+        base = Aggregate(plan.input, plan.group_exprs, rest)
+        base_cols = [L.Column(f.name) for f in base.schema()]
+        bz: list[L.Alias] = []
+        for i, (g, nl) in enumerate(zip(plan.group_exprs, nullable)):
+            c = L.Column(g.name())
+            dt = g.data_type(ins)
+            if nl:
+                bz.append(
+                    L.Alias(
+                        L.Case(((L.IsNotNull(c), c),), _zero_lit(dt)),
+                        f"__bz{i}",
+                    )
+                )
+                bz.append(L.Alias(L.IsNull(c), f"__bzn{i}"))
+            else:
+                bz.append(L.Alias(c, f"__bz{i}"))
+        joined: LogicalPlan = Projection(base, tuple(base_cols + bz))
+        base_keys = [a.aname for a in bz]
+        for p, pk in pieces:
+            joined = join2(joined, base_keys, p, pk)
+        group_out = [L.Column(g.name()) for g in plan.group_exprs]
+    else:
+        joined, first_keys = pieces[0]
+        for p, pk in pieces[1:]:
+            joined = join2(joined, first_keys, p, pk)
+        # reconstruct original group values (NULL where the flag is set)
+        group_out = []
+        ki = 0
+        for g, nl in zip(plan.group_exprs, nullable):
+            zc = L.Column(f"__pg0_{ki}")
+            if nl:
+                group_out.append(
+                    L.Alias(
+                        L.Case(
+                            ((L.Not(L.Column(f"__pg0_n{ki}")), zc),), None
+                        ),
+                        g.name(),
+                    )
+                )
+            else:
+                group_out.append(L.Alias(zc, g.name()))
+            ki += 1
+
+    # restore the original Aggregate output schema (names and order)
+    out_exprs: list[L.Expr] = list(group_out)
+    for e in plan.agg_exprs:
+        if isinstance(e, L.PercentileExpr):
+            out_exprs.append(L.Alias(L.Column(out_of[id(e)]), e.name()))
+        else:
+            out_exprs.append(L.Column(e.name()))
+    return Projection(joined, tuple(out_exprs))
 
 
 # -- generic plan/expression mapping -----------------------------------------
@@ -604,6 +761,11 @@ def _prune(plan: LogicalPlan, required: set[str] | None) -> LogicalPlan:
     if isinstance(plan, Union):
         # column pruning across union requires positional mapping; skip.
         return plan.with_children([_prune(c, None) for c in plan.children()])
+    if isinstance(plan, Percentile):
+        need = _expr_columns(
+            list(plan.group_exprs) + [v for v, _, _ in plan.requests]
+        )
+        return plan.with_children([_prune(plan.input, need)])
     if isinstance(plan, (EmptyRelation,)):
         return plan
     return plan.with_children([_prune(c, required) for c in plan.children()])

@@ -43,6 +43,31 @@ PLUGIN_DIR_ENV = "BALLISTA_PLUGIN_DIR"  # ref plugin/mod.rs:36-44
 
 
 @dataclasses.dataclass(frozen=True)
+class AggregateUdf:
+    """One registered aggregate UDF (ref python/src/udaf.rs:28-90 — the
+    Accumulator's state/update/merge/evaluate contract, recast for a
+    vectorized engine).
+
+    A UDAF here is ALGEBRAIC: it declares state slots, each an engine
+    reduce op (sum/count/min/max) over a jax-traceable per-row transform
+    of the argument, plus a jax-traceable ``finalize`` over the merged
+    slot values. That maps 1:1 onto the partial/merge/final split the
+    distributed plan already runs for built-ins (partials fold per
+    partition, states merge by the slot op, finalize runs once) — the
+    reference's row-loop Accumulator would serialize on a TPU.
+
+    ``states``: list of (suffix, op, transform) with op in
+    {"sum", "count", "min", "max"} and transform a jnp callable (or None
+    for the raw argument). ``finalize(*slot_values) -> jnp array``.
+    """
+
+    name: str
+    states: tuple
+    finalize: object
+    return_type: object = DataType.FLOAT64
+
+
+@dataclasses.dataclass(frozen=True)
 class ScalarUdf:
     """One registered scalar UDF.
 
@@ -64,6 +89,7 @@ class UdfRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._udfs: dict[str, ScalarUdf] = {}
+        self._udafs: dict[str, AggregateUdf] = {}
         # dir -> Event set once its plugins are fully registered; a second
         # loader of the same dir blocks until then (concurrent push-mode
         # task threads must not see a half-loaded registry)
@@ -83,17 +109,59 @@ class UdfRegistry:
                 name, fn, return_type, min_args, max_args or min_args
             )
 
+    def register_udaf(
+        self,
+        name: str,
+        states: list,
+        finalize,
+        return_type=DataType.FLOAT64,
+    ) -> None:
+        """Register an aggregate UDF (see AggregateUdf). Each state's
+        transform is ALSO registered as a hidden scalar UDF so the
+        decomposition can reference it as an ordinary pre-projection
+        expression that serializes by name."""
+        name = name.lower()
+        norm = []
+        for state in states:
+            suffix, op, transform = state[:3]
+            # transform output dtype: explicit 4th element, else FLOAT64
+            # ("same" would silently truncate float-producing transforms
+            # over integer columns — log, sqrt, reciprocals)
+            rtype = state[3] if len(state) > 3 else DataType.FLOAT64
+            if op not in ("sum", "count", "min", "max"):
+                raise PlanError(
+                    f"UDAF {name!r} state {suffix!r}: bad op {op!r}"
+                )
+            if transform is not None:
+                self.register(
+                    f"__udaf_{name}_{suffix}", transform, rtype
+                )
+            norm.append((suffix, op, transform is not None))
+        with self._lock:
+            self._udafs[name] = AggregateUdf(
+                name, tuple(norm), finalize, return_type
+            )
+
     def get(self, name: str) -> ScalarUdf | None:
         with self._lock:
             return self._udfs.get(name.lower())
+
+    def get_udaf(self, name: str) -> AggregateUdf | None:
+        with self._lock:
+            return self._udafs.get(name.lower())
 
     def names(self) -> list[str]:
         with self._lock:
             return sorted(self._udfs)
 
+    def udaf_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._udafs)
+
     def clear(self) -> None:
         with self._lock:
             self._udfs.clear()
+            self._udafs.clear()
             self._dir_loads.clear()
 
     def load_dir(self, plugin_dir: str) -> list[str]:
@@ -139,7 +207,16 @@ class UdfRegistry:
                     if hook is None:
                         log.warning("plugin %s has no register() hook", path)
                         continue
-                    hook(self.register)
+                    import inspect
+
+                    n_params = len(
+                        inspect.signature(hook).parameters
+                    )
+                    if n_params >= 2:
+                        # register(register_udf, register_udaf)
+                        hook(self.register, self.register_udaf)
+                    else:
+                        hook(self.register)
                     loaded.append(mod_name)
                 except Exception:  # noqa: BLE001 — one bad plugin can't
                     # kill boot, but its failure must not be cached as
@@ -178,3 +255,10 @@ def lookup_udf(name: str) -> ScalarUdf:
     if udf is None:
         raise PlanError(f"unknown scalar function {name!r}")
     return udf
+
+
+def lookup_udaf(name: str) -> AggregateUdf:
+    udaf = global_registry.get_udaf(name)
+    if udaf is None:
+        raise PlanError(f"unknown aggregate function {name!r}")
+    return udaf

@@ -155,6 +155,19 @@ def expr_to_proto(e: L.Expr) -> pb.ExprNode:
         return pb.ExprNode(
             alias=pb.AliasNode(expr=expr_to_proto(e.expr), alias=e.aname)
         )
+    if isinstance(e, L.PercentileExpr):
+        return pb.ExprNode(
+            aggregate=pb.AggregateExprNode(
+                is_percentile=True, percentile_q=e.q,
+                arg=expr_to_proto(e.arg),
+            )
+        )
+    if isinstance(e, L.UdafExpr):
+        return pb.ExprNode(
+            aggregate=pb.AggregateExprNode(
+                udaf=e.uname, arg=expr_to_proto(e.arg)
+            )
+        )
     if isinstance(e, L.AggregateExpr):
         return pb.ExprNode(
             aggregate=pb.AggregateExprNode(
@@ -243,6 +256,14 @@ def expr_from_proto(p: pb.ExprNode) -> L.Expr:
     if kind == "alias":
         return L.Alias(expr_from_proto(p.alias.expr), p.alias.alias)
     if kind == "aggregate":
+        if p.aggregate.is_percentile:
+            return L.PercentileExpr(
+                expr_from_proto(p.aggregate.arg), p.aggregate.percentile_q
+            )
+        if p.aggregate.udaf:
+            return L.UdafExpr(
+                p.aggregate.udaf, expr_from_proto(p.aggregate.arg)
+            )
         return L.AggregateExpr(
             L.AggFunc[pb.AggFuncP.Name(p.aggregate.func)[4:]],
             expr_from_proto(p.aggregate.arg),
@@ -371,6 +392,17 @@ def logical_to_proto(plan: P.LogicalPlan) -> pb.LogicalPlanNode:
                 input=logical_to_proto(plan.input),
                 exprs=[_window_expr_to_proto(w) for w in plan.window_exprs],
                 names=list(plan.names),
+            )
+        )
+    if isinstance(plan, P.Percentile):
+        return pb.LogicalPlanNode(
+            percentile=pb.PercentileNode(
+                input=logical_to_proto(plan.input),
+                group_exprs=[expr_to_proto(e) for e in plan.group_exprs],
+                group_names=list(plan.group_names),
+                values=[expr_to_proto(v) for v, _, _ in plan.requests],
+                qs=[q for _, q, _ in plan.requests],
+                out_names=[n for _, _, n in plan.requests],
             )
         )
     if isinstance(plan, P.Distinct):
@@ -517,6 +549,17 @@ def logical_from_proto(p: pb.LogicalPlanNode) -> P.LogicalPlan:
             logical_from_proto(p.window.input),
             tuple(_window_expr_from_proto(w) for w in p.window.exprs),
             tuple(p.window.names),
+        )
+    if kind == "percentile":
+        n = p.percentile
+        return P.Percentile(
+            logical_from_proto(n.input),
+            tuple(expr_from_proto(e) for e in n.group_exprs),
+            tuple(n.group_names),
+            tuple(
+                (expr_from_proto(v), q, nm)
+                for v, q, nm in zip(n.values, n.qs, n.out_names)
+            ),
         )
     if kind == "subquery_alias":
         return P.SubqueryAlias(
@@ -671,6 +714,21 @@ class BallistaCodec:
                         _window_expr_to_proto(w) for w in plan.window_exprs
                     ],
                     names=list(plan.names),
+                )
+            )
+        from ballista_tpu.exec.percentile import PercentileExec
+
+        if isinstance(plan, PercentileExec):
+            return pb.PhysicalPlanNode(
+                percentile=pb.PhysicalPercentileNode(
+                    input=self.physical_to_proto(plan.input),
+                    group_exprs=[
+                        expr_to_proto(e) for e in plan.group_exprs
+                    ],
+                    group_names=list(plan.group_names),
+                    values=[expr_to_proto(v) for v, _, _ in plan.requests],
+                    qs=[q for _, q, _ in plan.requests],
+                    out_names=[n for _, _, n in plan.requests],
                 )
             )
         if isinstance(plan, EmptyExec):
@@ -875,6 +933,19 @@ class BallistaCodec:
                 self.physical_from_proto(p.window.input),
                 [_window_expr_from_proto(w) for w in p.window.exprs],
                 list(p.window.names),
+            )
+        if kind == "percentile":
+            from ballista_tpu.exec.percentile import PercentileExec
+
+            n = p.percentile
+            return PercentileExec(
+                self.physical_from_proto(n.input),
+                [expr_from_proto(e) for e in n.group_exprs],
+                list(n.group_names),
+                [
+                    (expr_from_proto(v), q, nm)
+                    for v, q, nm in zip(n.values, n.qs, n.out_names)
+                ],
             )
         if kind == "empty":
             return EmptyExec(

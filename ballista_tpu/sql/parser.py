@@ -725,6 +725,31 @@ class Parser:
                 offset = int(t.value)
             self.expect_punct(")")
             return self.parse_over_clause(name, arg=arg, offset=offset)
+        if name in ("approx_percentile_cont", "percentile_cont", "median"):
+            arg = self.parse_expr()
+            if name == "median":
+                q = 0.5
+            else:
+                self.expect_punct(",")
+                t = self.next()
+                neg = False
+                if t.kind == Tok.OP and t.value == "-":
+                    neg = True
+                    t = self.next()
+                if t.kind != Tok.NUMBER:
+                    raise SqlError(
+                        f"{name}() percentile must be a numeric literal"
+                    )
+                q = -float(t.value) if neg else float(t.value)
+            self.expect_punct(")")
+            return L.PercentileExpr(arg, q)
+        from ballista_tpu.plugin import global_registry
+
+        if global_registry.get_udaf(name) is not None:
+            # registered aggregate UDF: aggregate-shaped call site
+            arg = self.parse_expr()
+            self.expect_punct(")")
+            return L.UdafExpr(name, arg)
         args: list[L.Expr] = []
         if not self.accept_punct(")"):
             args.append(self.parse_expr())

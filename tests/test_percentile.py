@@ -1,0 +1,168 @@
+"""approx_percentile_cont / median: holistic percentile aggregates.
+
+Oracle: numpy quantile (linear interpolation — the same continuous
+definition). DataFusion computes this through a t-digest sketch; the
+sort-first engine computes the EXACT answer (exec/percentile.py), split
+out of Aggregate nodes by the optimizer (plan/optimizer.split_percentiles)
+into a re-join on the group keys.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.errors import PlanError
+from ballista_tpu.exec.context import TpuContext
+
+
+@pytest.fixture(scope="module")
+def setup():
+    r = np.random.default_rng(13)
+    n = 3000
+    t = pa.table(
+        {
+            "g": pa.array(r.integers(0, 12, n).astype(np.int64)),
+            "v": pa.array(np.round(r.uniform(0, 100, n), 6)),
+            "w": pa.array(r.integers(1, 50, n).astype(np.int64)),
+        }
+    )
+    ctx = TpuContext()
+    ctx.register_table("t", t)
+    return ctx, t.to_pandas()
+
+
+def test_grouped_median_alone(setup):
+    ctx, df = setup
+    got = (
+        ctx.sql("select g, median(v) as m from t group by g order by g")
+        .collect()
+        .to_pandas()
+    )
+    want = df.groupby("g").v.median()
+    np.testing.assert_allclose(got.m.to_numpy(), want.to_numpy(), rtol=1e-9)
+
+
+def test_grouped_mixed_with_algebraic_aggs(setup):
+    ctx, df = setup
+    # the db-benchmark G1 q6 shape: percentile NEXT TO ordinary aggregates
+    got = (
+        ctx.sql(
+            "select g, approx_percentile_cont(v, 0.25) as q1, "
+            "median(v) as med, stddev(v) as sd, count(*) as c "
+            "from t group by g order by g"
+        )
+        .collect()
+        .to_pandas()
+    )
+    grp = df.groupby("g")
+    np.testing.assert_allclose(
+        got.q1.to_numpy(), grp.v.quantile(0.25).to_numpy(), rtol=1e-9
+    )
+    np.testing.assert_allclose(
+        got.med.to_numpy(), grp.v.median().to_numpy(), rtol=1e-9
+    )
+    np.testing.assert_allclose(
+        got.sd.to_numpy(), grp.v.std().to_numpy(), rtol=1e-6
+    )
+    assert got.c.tolist() == grp.size().tolist()
+
+
+def test_two_value_columns(setup):
+    ctx, df = setup
+    got = (
+        ctx.sql(
+            "select g, median(v) as mv, median(w) as mw "
+            "from t group by g order by g"
+        )
+        .collect()
+        .to_pandas()
+    )
+    grp = df.groupby("g")
+    np.testing.assert_allclose(
+        got.mv.to_numpy(), grp.v.median().to_numpy(), rtol=1e-9
+    )
+    np.testing.assert_allclose(
+        got.mw.to_numpy(), grp.w.median().to_numpy(), rtol=1e-9
+    )
+
+
+def test_ungrouped_percentiles(setup):
+    ctx, df = setup
+    got = (
+        ctx.sql(
+            "select approx_percentile_cont(v, 0.9) as p90, "
+            "sum(w) as s from t"
+        )
+        .collect()
+        .to_pandas()
+    )
+    np.testing.assert_allclose(
+        got.p90[0], df.v.quantile(0.9), rtol=1e-9
+    )
+    assert got.s[0] == df.w.sum()
+
+
+def test_percentile_with_nulls():
+    ctx = TpuContext()
+    t = pa.table(
+        {
+            "g": pa.array([0, 0, 0, 1, 1], type=pa.int64()),
+            "v": pa.array([1.0, None, 3.0, None, None]),
+        }
+    )
+    ctx.register_table("tn", t)
+    got = (
+        ctx.sql("select g, median(v) as m from tn group by g order by g")
+        .collect()
+        .to_pandas()
+    )
+    np.testing.assert_allclose(got.m[0], 2.0)
+    assert np.isnan(got.m[1])  # all-NULL group -> NULL
+
+
+def test_bad_percentile_rejected(setup):
+    ctx, _ = setup
+    with pytest.raises(PlanError):
+        ctx.sql(
+            "select approx_percentile_cont(v, 1.5) from t"
+        ).collect()
+
+
+def test_percentile_distributed():
+    """Through the standalone cluster (logical serde + stage split)."""
+    import subprocess
+    import sys
+
+    from tests.conftest import CPU_MESH_ENV
+
+    script = """
+import numpy as np
+import pyarrow as pa
+from ballista_tpu.client.context import BallistaContext
+
+ctx = BallistaContext.standalone()
+r = np.random.default_rng(3)
+g = r.integers(0, 6, 500); v = r.uniform(0, 10, 500)
+ctx.register_table("t", pa.table({"g": pa.array(g), "v": pa.array(v)}))
+got = ctx.sql(
+    "select g, median(v) as m, count(*) as c from t group by g order by g"
+).collect().to_pandas()
+import pandas as pd
+grp = pd.DataFrame({"g": g, "v": v}).groupby("g")
+np.testing.assert_allclose(got.m.to_numpy(), grp.v.median().to_numpy(), rtol=1e-9)
+assert got.c.tolist() == grp.size().tolist()
+ctx.close()
+print("PCT-DIST-OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        env=CPU_MESH_ENV,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "PCT-DIST-OK" in proc.stdout

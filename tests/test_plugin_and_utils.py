@@ -130,3 +130,79 @@ def test_produce_diagram():
     assert 'label = "Stage 1"' in dot
     assert "UnresolvedShuffleExec stage=1" in dot
     assert "[style=dashed]" in dot  # stage-1 writer feeds stage-2 reader
+
+
+def test_udaf_in_sql_distributed(tmp_path):
+    """A plugin UDAF (register_udaf) computes a custom aggregate both in
+    the local context and through the standalone cluster's two-phase
+    partial/merge/final split (ref python/src/udaf.rs semantics)."""
+    plugin = tmp_path / "plug"
+    plugin.mkdir()
+    (plugin / "aggs.py").write_text(
+        textwrap.dedent(
+            """
+            import jax.numpy as jnp
+            from ballista_tpu.datatypes import DataType
+
+            def register(register_udf, register_udaf):
+                # geometric mean: exp(avg(log x)) — an algebraic UDAF
+                # (sum-of-logs + count states, finalize combines)
+                register_udaf(
+                    "geo_mean",
+                    states=[
+                        ("slog", "sum", lambda x: jnp.log(x)),
+                        ("n", "count", None),
+                    ],
+                    finalize=lambda s, n: jnp.exp(
+                        s / jnp.maximum(n, 1).astype(jnp.float64)
+                    ),
+                    return_type=DataType.FLOAT64,
+                )
+            """
+        )
+    )
+    script = f"""
+import numpy as np
+import pyarrow as pa
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.client.context import BallistaContext
+
+cfg = BallistaConfig().with_setting("ballista.plugin_dir", {str(plugin)!r})
+ctx = BallistaContext.standalone(config=cfg)
+rng = np.random.default_rng(4)
+g = rng.integers(0, 5, 400)
+v = rng.uniform(0.5, 9.0, 400)
+ctx.register_table("t", pa.table({{"g": pa.array(g), "v": pa.array(v)}}))
+res = (
+    ctx.sql("select g, geo_mean(v) as gm from t group by g order by g")
+    .collect()
+    .to_pandas()
+)
+import pandas as pd
+want = (
+    pd.DataFrame({{"g": g, "v": v}})
+    .groupby("g")
+    .v.apply(lambda s: np.exp(np.log(s).mean()))
+)
+np.testing.assert_allclose(res.gm.to_numpy(), want.to_numpy(), rtol=1e-9)
+
+# the DataFrame builder reaches it too
+from ballista_tpu import functions as F
+res2 = (
+    ctx.table("t").aggregate(["g"], [F.udaf("geo_mean", "v").alias("gm")])
+    .sort("g").collect().to_pandas()
+)
+np.testing.assert_allclose(res2.gm.to_numpy(), want.to_numpy(), rtol=1e-9)
+ctx.close()
+print("UDAF-OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        env=CPU_MESH_ENV,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "UDAF-OK" in proc.stdout
