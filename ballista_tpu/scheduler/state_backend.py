@@ -17,13 +17,73 @@ Keys follow the reference's scheme: ``/ballista/<namespace>/...``
 
 from __future__ import annotations
 
+import dataclasses
+import queue
 import sqlite3
 import threading
 from typing import Iterator
 
 
+@dataclasses.dataclass(frozen=True)
+class WatchEvent:
+    """One observed mutation (ref backend/mod.rs:96-104 WatchEvent::Put /
+    Delete)."""
+
+    kind: str  # "put" | "delete"
+    key: str
+    value: bytes | None  # None for deletes
+
+
+class Watch:
+    """A live subscription to key mutations under a prefix (ref
+    backend/mod.rs:84-94 ``watch`` returning a Stream of WatchEvents).
+    Iterate for events; ``stop()`` ends the stream. Trigger-based: events
+    fire from this process's put/delete calls — the same visibility the
+    reference's sled-backed standalone watch has (cross-process watch is
+    etcd's job; see docs/deployment.md HA notes)."""
+
+    _STOP = object()
+
+    def __init__(self, prefix: str, unsubscribe) -> None:
+        self.prefix = prefix
+        self._q: queue.Queue = queue.Queue()
+        self._unsubscribe = unsubscribe
+        self._stopped = False
+
+    def _offer(self, event: WatchEvent) -> None:
+        self._q.put(event)
+
+    def stop(self) -> None:
+        if not self._stopped:
+            self._stopped = True
+            self._unsubscribe(self)
+            self._q.put(self._STOP)
+
+    def __iter__(self) -> "Watch":
+        return self
+
+    def __next__(self) -> WatchEvent:
+        item = self._q.get()
+        if item is self._STOP:
+            raise StopIteration
+        return item
+
+    def get(self, timeout: float | None = None) -> WatchEvent | None:
+        """Non-raising fetch: the next event, or None on timeout/stop."""
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return None if item is self._STOP else item
+
+
 class StateBackendClient:
-    """KV-store interface (ref backend/mod.rs:53-94)."""
+    """KV-store interface (ref backend/mod.rs:53-94: get, get_from_prefix,
+    put, lock, watch)."""
+
+    def __init__(self) -> None:
+        self._watchers: list[Watch] = []
+        self._watch_lock = threading.Lock()
 
     def get(self, key: str) -> bytes | None:
         raise NotImplementedError
@@ -42,12 +102,35 @@ class StateBackendClient:
         persistent_state.rs:313-319 global lock around each save)."""
         raise NotImplementedError
 
+    def watch(self, prefix: str) -> Watch:
+        """Subscribe to mutations under ``prefix``."""
+        w = Watch(prefix, self._unwatch)
+        with self._watch_lock:
+            self._watchers.append(w)
+        return w
+
+    def _unwatch(self, w: Watch) -> None:
+        with self._watch_lock:
+            if w in self._watchers:
+                self._watchers.remove(w)
+
+    def _notify(self, kind: str, key: str, value: bytes | None) -> None:
+        with self._watch_lock:
+            watchers = list(self._watchers)
+        for w in watchers:
+            if key.startswith(w.prefix):
+                w._offer(WatchEvent(kind, key, value))
+
     def close(self) -> None:
-        pass
+        with self._watch_lock:
+            watchers = list(self._watchers)
+        for w in watchers:
+            w.stop()
 
 
 class MemoryBackend(StateBackendClient):
     def __init__(self) -> None:
+        super().__init__()
         self._data: dict[str, bytes] = {}
         self._lock = threading.RLock()
 
@@ -64,10 +147,12 @@ class MemoryBackend(StateBackendClient):
     def put(self, key: str, value: bytes) -> None:
         with self._lock:
             self._data[key] = bytes(value)
+        self._notify("put", key, bytes(value))
 
     def delete(self, key: str) -> None:
         with self._lock:
             self._data.pop(key, None)
+        self._notify("delete", key, None)
 
     def lock(self):
         return self._lock
@@ -79,6 +164,7 @@ class SqliteBackend(StateBackendClient):
     crashed scheduler's last committed writes survive."""
 
     def __init__(self, path: str) -> None:
+        super().__init__()
         self.path = path
         self._lock = threading.RLock()
         self._conn = sqlite3.connect(path, check_same_thread=False)
@@ -114,15 +200,18 @@ class SqliteBackend(StateBackendClient):
                 (key, sqlite3.Binary(value)),
             )
             self._conn.commit()
+        self._notify("put", key, bytes(value))
 
     def delete(self, key: str) -> None:
         with self._lock:
             self._conn.execute("DELETE FROM kv WHERE key = ?", (key,))
             self._conn.commit()
+        self._notify("delete", key, None)
 
     def lock(self):
         return self._lock
 
     def close(self) -> None:
+        super().close()
         with self._lock:
             self._conn.close()

@@ -137,3 +137,44 @@ def test_inflight_job_fails_loudly_on_restart(tmp_path):
     assert j.status == "failed"
     assert "restart" in j.error
     recovered.shutdown()
+
+
+def test_state_backend_watch():
+    """watch(): trigger-based prefix subscription on both embedded
+    backends (ref backend/mod.rs:84-94)."""
+    import tempfile
+
+    from ballista_tpu.scheduler.state_backend import (
+        MemoryBackend,
+        SqliteBackend,
+    )
+
+    with tempfile.TemporaryDirectory() as d:
+        for be in (MemoryBackend(), SqliteBackend(f"{d}/kv.db")):
+            w = be.watch("/ballista/jobs/")
+            other = be.watch("/ballista/executors/")
+            be.put("/ballista/jobs/j1", b"queued")
+            be.put("/ballista/tasks/t1", b"x")  # outside the prefix
+            be.put("/ballista/jobs/j1", b"running")
+            be.delete("/ballista/jobs/j1")
+
+            e1 = w.get(timeout=1)
+            assert (e1.kind, e1.key, e1.value) == (
+                "put", "/ballista/jobs/j1", b"queued"
+            )
+            e2 = w.get(timeout=1)
+            assert e2.value == b"running"
+            e3 = w.get(timeout=1)
+            assert (e3.kind, e3.value) == ("delete", None)
+            assert w.get(timeout=0.05) is None  # no cross-prefix leak
+
+            oe = other.get(timeout=0.05)
+            assert oe is None  # nothing under its prefix
+
+            # stop ends iteration; close() stops remaining watchers
+            w.stop()
+            assert w.get(timeout=0.05) is None
+            be.put("/ballista/jobs/j2", b"y")
+            assert w.get(timeout=0.05) is None  # unsubscribed
+            be.close()
+            assert other.get(timeout=0.05) is None
