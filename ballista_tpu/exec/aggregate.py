@@ -663,6 +663,18 @@ class HashAggregateExec(ExecutionPlan):
                 )
                 if len(partials) >= self._FOLD_WIDTH:
                     partials = [fold(partials)]
+                    # BACKPRESSURE: dispatch on this platform is fully
+                    # async (block_until_ready is a no-op over the
+                    # tunnel), so without a real sync the host enqueues
+                    # every batch's whole upstream pipeline and the device
+                    # holds buffers for ALL of them — at SF=10 that is ~30
+                    # in-flight lineitem batches and an HBM OOM. One tiny
+                    # fetch per incremental fold drains the queue; the
+                    # fold never fires at small scales (< _FOLD_WIDTH
+                    # batches), so short queries pay nothing.
+                    from ballista_tpu.ops.fetch import fetch_arrays
+
+                    fetch_arrays([partials[0].valid[:1]])
             self.metrics.add("input_batches")
         if not partials:
             return

@@ -78,7 +78,10 @@ def fetch_arrays(arrays: list) -> list[np.ndarray]:
         off = 0
         for a, (dt, n) in zip(arrays, sig):
             v = buf[off : off + n].reshape(a.shape)
-            out.append(v.astype(np.dtype(dt)))
+            # garbage under null masks may be NaN/Inf; the cast back to an
+            # int dtype is still value-preserving for every LIVE lane
+            with np.errstate(invalid="ignore"):
+                out.append(v.astype(np.dtype(dt)))
             off += n
         return out
     groups: dict[str, list[int]] = {}

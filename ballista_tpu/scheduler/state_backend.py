@@ -74,7 +74,10 @@ class Watch:
             item = self._q.get(timeout=timeout)
         except queue.Empty:
             return None
-        return None if item is self._STOP else item
+        if item is self._STOP:
+            self._q.put(self._STOP)  # keep the sentinel for iterators
+            return None
+        return item
 
 
 class StateBackendClient:
@@ -145,14 +148,17 @@ class MemoryBackend(StateBackendClient):
             )
 
     def put(self, key: str, value: bytes) -> None:
+        v = bytes(value)
         with self._lock:
-            self._data[key] = bytes(value)
-        self._notify("put", key, bytes(value))
+            self._data[key] = v
+            # notify under the data lock: watchers must observe events in
+            # the order the writes were applied
+            self._notify("put", key, v)
 
     def delete(self, key: str) -> None:
         with self._lock:
             self._data.pop(key, None)
-        self._notify("delete", key, None)
+            self._notify("delete", key, None)
 
     def lock(self):
         return self._lock
@@ -193,20 +199,21 @@ class SqliteBackend(StateBackendClient):
         return [(k, bytes(v)) for k, v in rows]
 
     def put(self, key: str, value: bytes) -> None:
+        v = bytes(value)
         with self._lock:
             self._conn.execute(
                 "INSERT INTO kv (key, value) VALUES (?, ?) "
                 "ON CONFLICT(key) DO UPDATE SET value = excluded.value",
-                (key, sqlite3.Binary(value)),
+                (key, sqlite3.Binary(v)),
             )
             self._conn.commit()
-        self._notify("put", key, bytes(value))
+            self._notify("put", key, v)
 
     def delete(self, key: str) -> None:
         with self._lock:
             self._conn.execute("DELETE FROM kv WHERE key = ?", (key,))
             self._conn.commit()
-        self._notify("delete", key, None)
+            self._notify("delete", key, None)
 
     def lock(self):
         return self._lock
