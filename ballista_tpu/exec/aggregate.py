@@ -633,6 +633,7 @@ class HashAggregateExec(ExecutionPlan):
         partials: list[DeviceBatch] = []
         site = self.display()
         merge_ops = [s.op.merge_op for s in self.spec.slots]
+        bp_prev = None  # previous fold's async-copied backpressure flag
 
         def fold(states: list[DeviceBatch]) -> DeviceBatch:
             # slice states down to a learned capacity first (they are
@@ -668,13 +669,23 @@ class HashAggregateExec(ExecutionPlan):
                     # tunnel), so without a real sync the host enqueues
                     # every batch's whole upstream pipeline and the device
                     # holds buffers for ALL of them — at SF=10 that is ~30
-                    # in-flight lineitem batches and an HBM OOM. One tiny
-                    # fetch per incremental fold drains the queue; the
-                    # fold never fires at small scales (< _FOLD_WIDTH
-                    # batches), so short queries pay nothing.
-                    from ballista_tpu.ops.fetch import fetch_arrays
+                    # in-flight lineitem batches of HBM. Pipelined drain:
+                    # start an async host copy of THIS fold's flag and
+                    # block on the PREVIOUS fold's — in-flight work stays
+                    # bounded at ~2 fold windows while the round trip
+                    # overlaps the next window's dispatch. Folds never
+                    # fire below _FOLD_WIDTH batches, so short queries
+                    # pay nothing.
+                    import numpy as _np
 
-                    fetch_arrays([partials[0].valid[:1]])
+                    flag = partials[0].valid[:1]
+                    try:
+                        flag.copy_to_host_async()
+                    except Exception:  # platform without async copies
+                        pass
+                    if bp_prev is not None:
+                        _np.asarray(bp_prev)
+                    bp_prev = flag
             self.metrics.add("input_batches")
         if not partials:
             return

@@ -164,17 +164,22 @@ class TpuContext(Catalog, TableProvider):
                 r.kw["table"], r.schema, projection, partitions,
                 device_cache=cache,
             )
+        # file scans share a registration-lifetime cache too: parsed host
+        # table + uploaded device batches, invalidated by file mtime
+        scache = r.kw.setdefault("scan_cache", {})
         if r.kind == "csv":
             return CsvScanExec(
                 r.kw["path"], r.schema, r.kw["has_header"], r.kw["delimiter"],
-                projection, partitions,
+                projection, partitions, scan_cache=scache,
             )
         if r.kind == "avro":
             return AvroScanExec(
                 r.kw["path"], r.schema, projection, partitions,
+                scan_cache=scache,
             )
         return ParquetScanExec(
             r.kw["path"], r.schema, projection, partitions,
+            scan_cache=scache,
         )
 
     # -- DataFrame entry points (ref client context.rs:211-253 read_csv /

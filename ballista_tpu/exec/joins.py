@@ -276,6 +276,7 @@ class HashJoinExec(ExecutionPlan):
         flipping to build a unique left side (fixed-capacity probe, no
         expansion); if BOTH sides have duplicates, run the m:n expansion
         join with the right side as build."""
+        ls, rs = self.left.schema(), self.right.schema()
         with self.metrics.time("build_time"):
             right_batch = _collect(self.right, ctx)
 
@@ -340,7 +341,7 @@ class HashJoinExec(ExecutionPlan):
                     cache[lfp] = lflags
             lbt_dups, lbt_ovf = lflags[0], lflags[1]
             if not lbt_dups and not lbt_ovf:
-                # flip: build (unique) left, probe the collected right
+                # flip: build (unique) left, probe the right side
                 if l_from_cache:
                     ctx.defer_speculation(
                         lbt.spec_flag(),
@@ -353,14 +354,50 @@ class HashJoinExec(ExecutionPlan):
                 )
                 if not contig:
                     self._maybe_attach_lut(lbt, rb.capacity, ctx, lfp)
-                joined = self._probe_with_filter(
-                    lbt, rb, right_keys, JoinSide.INNER, contig
+                key_strings = any(
+                    ls.fields[i].dtype == DataType.STRING
+                    for i in left_keys
+                ) or any(
+                    rs.fields[i].dtype == DataType.STRING
+                    for i in right_keys
                 )
-                out = self._restore_column_order(
-                    joined, rb, lbt.batch, build_is_right=False
-                )
-                self.metrics.add("output_batches")
-                yield out
+                if key_strings:
+                    # string keys were dictionary-unified against the
+                    # COLLECTED right; probe it in one shot
+                    joined = self._probe_with_filter(
+                        lbt, rb, right_keys, JoinSide.INNER, contig
+                    )
+                    out = self._restore_column_order(
+                        joined, rb, lbt.batch, build_is_right=False
+                    )
+                    self.metrics.add("output_batches")
+                    yield out
+                    return
+                # int keys: STREAM the probe side batch-by-batch. The
+                # collected right is a fact table in the common flip shape
+                # (TPC-H puts lineitem on the join's right), and probing
+                # it as ONE program allocates gather intermediates at the
+                # FULL collected capacity — 64M rows x ~10 columns at
+                # SF=10, an instant HBM OOM. Streaming probes at scan
+                # batch granularity instead; the collected copy is only
+                # the strategy-decision input and is dropped here.
+                from ballista_tpu.exec.shrink import maybe_shrink
+
+                # free the collected right AND the decide build's sorted
+                # copy of it before streaming
+                right_batch = rb = lb = decide = None
+                site = self.display()
+                rpart = self.right.output_partitioning()
+                for p in range(rpart.n):
+                    for b in self.right.execute(p, ctx):
+                        joined = self._probe_with_filter(
+                            lbt, b, right_keys, JoinSide.INNER, contig
+                        )
+                        out = self._restore_column_order(
+                            joined, b, lbt.batch, build_is_right=False
+                        )
+                        self.metrics.add("output_batches")
+                        yield maybe_shrink(out, ctx, site, 0)
                 return
             # both sides duplicated: m:n expansion, building whichever side
             # has no collision overflow (expansion needs countable runs)

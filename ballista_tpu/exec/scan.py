@@ -138,7 +138,15 @@ class _StagedFileScanExec(ExecutionPlan):
         projection: list[str] | None = None,
         partitions: int = 1,
         batch_rows: int | None = None,
+        scan_cache: dict | None = None,
     ) -> None:
+        """``scan_cache``: an optionally shared, registration-lifetime dict
+        (the context passes its per-table cache) holding the parsed host
+        table AND the uploaded DeviceBatches across queries, keyed by the
+        file's mtime so an overwritten file invalidates both tiers. The
+        same residency rationale as MemoryScanExec's device_cache — on a
+        tunnelled TPU a warm file scan otherwise re-parses AND re-uploads
+        gigabytes per query."""
         super().__init__()
         self.path = path
         self.table_schema = table_schema
@@ -148,8 +156,17 @@ class _StagedFileScanExec(ExecutionPlan):
         )
         self.partitions = max(1, partitions)
         self.batch_rows = batch_rows
+        self.scan_cache = scan_cache
         self._table: pa.Table | None = None
         self._narrow_cols: frozenset | None = None
+
+    def _mtime(self) -> float:
+        import os
+
+        try:
+            return os.stat(self.path).st_mtime
+        except OSError:
+            return -1.0
 
     def schema(self) -> Schema:
         return self._schema
@@ -161,8 +178,20 @@ class _StagedFileScanExec(ExecutionPlan):
         raise NotImplementedError
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        dev_cache = None
+        if self.scan_cache is not None:
+            mt = self._mtime()
+            hkey = ("host", mt)
+            if self._table is None:
+                self._table = self.scan_cache.get(hkey)
+            if self._table is None:
+                # a rewritten file drops BOTH tiers for the old mtime
+                self.scan_cache.clear()
+            dev_cache = self.scan_cache.setdefault(("dev", mt), {})
         with self.metrics.time("read_time"):
             t = self._read()
+        if self.scan_cache is not None:
+            self.scan_cache[hkey] = t
         if self._narrow_cols is None:
             # computed ONCE per operator (not per partition) over the full
             # parsed table, like _read caches the parse itself
@@ -173,7 +202,7 @@ class _StagedFileScanExec(ExecutionPlan):
             self._narrow_cols = narrowable_int64_cols(t)
         mem = MemoryScanExec(
             t, self.table_schema, self.projection, self.partitions,
-            self.batch_rows,
+            self.batch_rows, device_cache=dev_cache,
         )
         mem.narrow_cols = self._narrow_cols
         yield from mem.execute(partition, ctx)
@@ -191,9 +220,11 @@ class CsvScanExec(_StagedFileScanExec):
         projection: list[str] | None = None,
         partitions: int = 1,
         batch_rows: int | None = None,
+        scan_cache: dict | None = None,
     ) -> None:
         super().__init__(
-            path, table_schema, projection, partitions, batch_rows
+            path, table_schema, projection, partitions, batch_rows,
+            scan_cache,
         )
         self.has_header = has_header
         self.delimiter = delimiter
@@ -368,6 +399,7 @@ class ParquetScanExec(ExecutionPlan):
         partitions: int = 1,
         batch_rows: int | None = None,
         predicates: list | None = None,
+        scan_cache: dict | None = None,
     ) -> None:
         super().__init__()
         self.path = path
@@ -379,6 +411,7 @@ class ParquetScanExec(ExecutionPlan):
         self.partitions = max(1, partitions)
         self.batch_rows = batch_rows
         self.predicates = list(predicates or [])
+        self.scan_cache = scan_cache
         self._kept_groups: list[int] | None = None
 
     def schema(self) -> Schema:
@@ -439,11 +472,34 @@ class ParquetScanExec(ExecutionPlan):
         if not groups:
             yield DeviceBatch.empty(self._schema)
             return
-        with self.metrics.time("read_time"):
-            t = f.read_row_groups(groups, columns=cols)
-        # column order must match the projected schema
-        t = t.select([fld.name for fld in self._schema])
-        mem = MemoryScanExec(t, self._schema, None, 1, self.batch_rows)
+        dev_cache = None
+        t = None
+        hkey = None
+        if self.scan_cache is not None:
+            import os
+
+            try:
+                mt = os.stat(self.path).st_mtime
+            except OSError:
+                mt = -1.0
+            if self.scan_cache.get("mtime") != mt:
+                self.scan_cache.clear()  # rewritten file: drop both tiers
+                self.scan_cache["mtime"] = mt
+            sub = (tuple(groups), tuple(cols or ()))
+            hkey = ("host",) + sub
+            t = self.scan_cache.get(hkey)
+            dev_cache = self.scan_cache.setdefault(("dev",) + sub, {})
+        if t is None:
+            with self.metrics.time("read_time"):
+                t = f.read_row_groups(groups, columns=cols)
+            # column order must match the projected schema
+            t = t.select([fld.name for fld in self._schema])
+            if self.scan_cache is not None:
+                self.scan_cache[hkey] = t
+        mem = MemoryScanExec(
+            t, self._schema, None, 1, self.batch_rows,
+            device_cache=dev_cache,
+        )
         # narrow by FILE-level statistics (all row groups), not this
         # partition's subset — partitions must share one physical layout
         mem.narrow_cols = self._narrowable_from_stats(f)
