@@ -54,13 +54,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--state-backend",
         default=_env("state_backend", "memory"),
-        choices=["memory", "sqlite"],
-        help="standalone(sled)->memory, etcd->sqlite equivalents",
+        choices=["memory", "sqlite", "etcd"],
+        help="memory (ephemeral), sqlite (embedded/sled analogue), or "
+        "etcd (HA/multi-scheduler, ref state/backend/etcd.rs:32-196)",
     )
     p.add_argument(
         "--state-path",
         default=_env("state_path", "ballista-scheduler-state.db"),
         help="sqlite file path when --state-backend=sqlite",
+    )
+    p.add_argument(
+        "--etcd-urls",
+        default=_env("etcd_urls", "localhost:2379"),
+        help="etcd endpoints (host:port[,host:port...]) when "
+        "--state-backend=etcd (ref scheduler main.rs --etcd-urls)",
     )
     p.add_argument(
         "--executor-timeout-seconds",
@@ -86,11 +93,14 @@ def main(argv: list[str] | None = None) -> int:
         SqliteBackend,
     )
 
-    backend = (
-        SqliteBackend(args.state_path)
-        if args.state_backend == "sqlite"
-        else MemoryBackend()
-    )
+    if args.state_backend == "etcd":
+        from ballista_tpu.scheduler.etcd_backend import EtcdBackend
+
+        backend = EtcdBackend(args.etcd_urls)
+    elif args.state_backend == "sqlite":
+        backend = SqliteBackend(args.state_path)
+    else:
+        backend = MemoryBackend()
     server = SchedulerServer(
         provider=None,
         config=BallistaConfig(),

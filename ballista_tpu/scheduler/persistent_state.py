@@ -58,7 +58,8 @@ class PersistentSchedulerState:
                 "task_slots": meta.specification.task_slots,
             }
         ).encode()
-        self.backend.put(self._k("executor_metadata", meta.id), payload)
+        with self.backend.lock():  # ref persistent_state.rs:313-319
+            self.backend.put(self._k("executor_metadata", meta.id), payload)
 
     def load_executors(self) -> list[ExecutorMetadata]:
         out = []
@@ -81,9 +82,11 @@ class PersistentSchedulerState:
 
     # -- sessions ------------------------------------------------------------
     def save_session(self, session_id: str, settings: dict[str, str]) -> None:
-        self.backend.put(
-            self._k("sessions", session_id), json.dumps(settings).encode()
-        )
+        with self.backend.lock():
+            self.backend.put(
+                self._k("sessions", session_id),
+                json.dumps(settings).encode(),
+            )
 
     def load_sessions(self) -> dict[str, dict[str, str]]:
         return {
@@ -114,7 +117,8 @@ class PersistentSchedulerState:
                 ],
             }
         ).encode()
-        self.backend.put(self._k("jobs", job.job_id), payload)
+        with self.backend.lock():
+            self.backend.put(self._k("jobs", job.job_id), payload)
 
     def load_jobs(self) -> list[dict]:
         return [
@@ -127,7 +131,8 @@ class PersistentSchedulerState:
         if self.codec is None:
             return
         data = self.codec.physical_to_proto(plan).SerializeToString()
-        self.backend.put(self._k("stages", job_id, str(stage_id)), data)
+        with self.backend.lock():
+            self.backend.put(self._k("stages", job_id, str(stage_id)), data)
 
     def load_stage_plans(self, job_id: str) -> dict[int, object]:
         """stage_id -> decoded physical plan."""

@@ -1,0 +1,279 @@
+"""EtcdBackend against an in-process fake etcd speaking the real wire
+protocol (ref ballista/rust/scheduler/src/state/backend/etcd.rs:32-196;
+no etcd binary ships in this image, so the server side is a faithful
+dict-backed stand-in registered under the genuine etcd service paths —
+the client under test is byte-for-byte what would talk to a real
+cluster)."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from concurrent import futures
+
+import grpc
+import pytest
+
+from ballista_tpu.proto import etcd_pb2 as epb
+from ballista_tpu.scheduler.etcd_backend import EtcdBackend, prefix_end
+
+
+class FakeEtcd:
+    """KV + Watch + Lease + v3 Lock over a dict, mod-revision tracked."""
+
+    def __init__(self) -> None:
+        self._kv: dict[bytes, bytes] = {}
+        self._rev = 0
+        self._mu = threading.Lock()
+        self._watches: list[tuple[bytes, bytes, object]] = []
+        self._lease_ids = itertools.count(1)
+        self._lock_cv = threading.Condition()
+        self._lock_holder: bytes | None = None
+
+    # -- KV ----------------------------------------------------------------
+    def _in_range(self, k: bytes, key: bytes, range_end: bytes) -> bool:
+        if not range_end:
+            return k == key
+        if range_end == b"\x00":
+            return k >= key
+        return key <= k < range_end
+
+    def Range(self, req: epb.RangeRequest, ctx) -> epb.RangeResponse:
+        with self._mu:
+            kvs = sorted(
+                (k, v) for k, v in self._kv.items()
+                if self._in_range(k, req.key, req.range_end)
+            )
+            resp = epb.RangeResponse(count=len(kvs))
+            resp.header.revision = self._rev
+            for k, v in kvs:
+                resp.kvs.add(key=k, value=v, mod_revision=self._rev)
+            return resp
+
+    def _broadcast(self, ev: epb.Event) -> None:
+        for key, range_end, q in list(self._watches):
+            if self._in_range(ev.kv.key, key, range_end):
+                q.append(ev)
+
+    def Put(self, req: epb.PutRequest, ctx) -> epb.PutResponse:
+        with self._mu:
+            self._rev += 1
+            self._kv[req.key] = req.value
+            ev = epb.Event(type=epb.Event.PUT)
+            ev.kv.key, ev.kv.value = req.key, req.value
+            ev.kv.mod_revision = self._rev
+            self._broadcast(ev)
+            resp = epb.PutResponse()
+            resp.header.revision = self._rev
+            return resp
+
+    def DeleteRange(self, req: epb.DeleteRangeRequest, ctx):
+        with self._mu:
+            gone = [k for k in self._kv
+                    if self._in_range(k, req.key, req.range_end)]
+            self._rev += 1
+            for k in gone:
+                del self._kv[k]
+                ev = epb.Event(type=epb.Event.DELETE)
+                ev.kv.key = k
+                ev.kv.mod_revision = self._rev
+                self._broadcast(ev)
+            resp = epb.DeleteRangeResponse(deleted=len(gone))
+            resp.header.revision = self._rev
+            return resp
+
+    # -- Watch (bidi) ------------------------------------------------------
+    def Watch(self, request_iter, ctx):
+        sub: list | None = None
+        try:
+            req = next(request_iter)
+        except StopIteration:
+            return
+        if req.HasField("create_request"):
+            cr = req.create_request
+            sub = []
+            with self._mu:
+                self._watches.append((cr.key, cr.range_end, sub))
+            yield epb.WatchResponse(watch_id=1, created=True)
+            try:
+                while ctx.is_active():
+                    if sub:
+                        resp = epb.WatchResponse(watch_id=1)
+                        while sub:
+                            resp.events.append(sub.pop(0))
+                        yield resp
+                    else:
+                        time.sleep(0.01)
+            finally:
+                with self._mu:
+                    self._watches = [
+                        w for w in self._watches if w[2] is not sub
+                    ]
+
+    # -- Lease + Lock ------------------------------------------------------
+    def LeaseGrant(self, req, ctx):
+        return epb.LeaseGrantResponse(ID=next(self._lease_ids), TTL=req.TTL)
+
+    def LeaseRevoke(self, req, ctx):
+        return epb.LeaseRevokeResponse()
+
+    def Lock(self, req: epb.LockRequest, ctx):
+        key = req.name + b"/%d" % req.lease
+        with self._lock_cv:
+            while self._lock_holder is not None:
+                self._lock_cv.wait()
+            self._lock_holder = key
+        return epb.LockResponse(key=key)
+
+    def Unlock(self, req: epb.UnlockRequest, ctx):
+        with self._lock_cv:
+            if self._lock_holder == req.key:
+                self._lock_holder = None
+                self._lock_cv.notify_all()
+        return epb.UnlockResponse()
+
+
+def _serve(fake: FakeEtcd):
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+
+    def unary(fn, req_cls):
+        return grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=req_cls.FromString,
+            response_serializer=lambda r: r.SerializeToString())
+
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler("etcdserverpb.KV", {
+            "Range": unary(fake.Range, epb.RangeRequest),
+            "Put": unary(fake.Put, epb.PutRequest),
+            "DeleteRange": unary(fake.DeleteRange, epb.DeleteRangeRequest),
+        }),
+        grpc.method_handlers_generic_handler("etcdserverpb.Watch", {
+            "Watch": grpc.stream_stream_rpc_method_handler(
+                fake.Watch,
+                request_deserializer=epb.WatchRequest.FromString,
+                response_serializer=lambda r: r.SerializeToString()),
+        }),
+        grpc.method_handlers_generic_handler("etcdserverpb.Lease", {
+            "LeaseGrant": unary(fake.LeaseGrant, epb.LeaseGrantRequest),
+            "LeaseRevoke": unary(fake.LeaseRevoke, epb.LeaseRevokeRequest),
+        }),
+        grpc.method_handlers_generic_handler("v3lockpb.Lock", {
+            "Lock": unary(fake.Lock, epb.LockRequest),
+            "Unlock": unary(fake.Unlock, epb.UnlockRequest),
+        }),
+    ))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    return server, f"127.0.0.1:{port}"
+
+
+@pytest.fixture()
+def etcd():
+    server, url = _serve(FakeEtcd())
+    yield url
+    server.stop(grace=None)
+
+
+def test_prefix_end():
+    assert prefix_end(b"/ballista/") == b"/ballista0"
+    assert prefix_end(b"a\xff") == b"b"
+    assert prefix_end(b"\xff\xff") == b"\x00"  # whole keyspace
+
+
+def test_etcd_kv_and_prefix(etcd):
+    be = EtcdBackend(etcd)
+    assert be.get("/ballista/jobs/j1") is None
+    be.put("/ballista/jobs/j1", b"queued")
+    be.put("/ballista/jobs/j2", b"running")
+    be.put("/ballista/executors/e1", b"alive")
+    assert be.get("/ballista/jobs/j1") == b"queued"
+    assert be.get_from_prefix("/ballista/jobs/") == [
+        ("/ballista/jobs/j1", b"queued"),
+        ("/ballista/jobs/j2", b"running"),
+    ]
+    be.delete("/ballista/jobs/j1")
+    assert be.get("/ballista/jobs/j1") is None
+    be.close()
+
+
+def test_etcd_watch_sees_other_clients(etcd):
+    """The property the embedded backends cannot give: a watch on one
+    scheduler observes writes made by ANOTHER scheduler process."""
+    a, b = EtcdBackend(etcd), EtcdBackend(etcd)
+    w = a.watch("/ballista/jobs/")  # blocks until the server acks created
+    b.put("/ballista/jobs/j1", b"queued")
+    b.put("/ballista/other/x", b"ignored")
+    b.delete("/ballista/jobs/j1")
+    e1 = w.get(timeout=2)
+    assert (e1.kind, e1.key, e1.value) == ("put", "/ballista/jobs/j1",
+                                           b"queued")
+    e2 = w.get(timeout=2)
+    assert (e2.kind, e2.value) == ("delete", None)
+    assert w.get(timeout=0.05) is None
+    w.stop()
+    a.close()
+    b.close()
+
+
+def test_etcd_global_lock_mutual_exclusion(etcd):
+    a, b = EtcdBackend(etcd), EtcdBackend(etcd)
+    order: list[str] = []
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with a.lock():
+            order.append("a-in")
+            entered.set()
+            release.wait(timeout=5)
+            order.append("a-out")
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert entered.wait(timeout=5)
+    t2_done = threading.Event()
+
+    def contender():
+        with b.lock():
+            order.append("b-in")
+            t2_done.set()
+
+    t2 = threading.Thread(target=contender)
+    t2.start()
+    time.sleep(0.2)
+    assert "b-in" not in order  # blocked while a holds it
+    release.set()
+    assert t2_done.wait(timeout=5)
+    t.join(timeout=5)
+    t2.join(timeout=5)
+    assert order == ["a-in", "a-out", "b-in"]
+    a.close()
+    b.close()
+
+
+def test_persistent_state_over_etcd(etcd):
+    """Scheduler restart recovery through etcd: state written by one
+    'scheduler' instance is re-initialized by a fresh one pointed at the
+    same cluster (ref persistent_state.rs:401-525 exercised over the
+    etcd backend instead of sled)."""
+    from ballista_tpu.scheduler.persistent_state import (
+        PersistentSchedulerState,
+    )
+    from ballista_tpu.scheduler_types import (
+        ExecutorMetadata,
+        ExecutorSpecification,
+    )
+
+    be = EtcdBackend(etcd)
+    st = PersistentSchedulerState(be, namespace="t")
+    st.save_executor_metadata(ExecutorMetadata(
+        id="e1", host="h", port=1, grpc_port=2,
+        specification=ExecutorSpecification(task_slots=4)))
+    be.close()
+
+    be2 = EtcdBackend(etcd)
+    st2 = PersistentSchedulerState(be2, namespace="t")
+    metas = st2.load_executors()
+    assert [(m.id, m.specification.task_slots) for m in metas] == [("e1", 4)]
+    be2.close()
