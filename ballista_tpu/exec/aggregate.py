@@ -615,7 +615,12 @@ class HashAggregateExec(ExecutionPlan):
     ) -> Iterator[DeviceBatch]:
         from ballista_tpu.exec.pipeline import ProjectionExec
 
-        pre = ProjectionExec(self.input, self._pre_exprs)
+        # cached on self: a fresh ProjectionExec per call would rebuild
+        # (and re-trace) the fused filter+projection chain every
+        # partition of every run, defeating the plan cache
+        if getattr(self, "_pre_plan", None) is None:
+            self._pre_plan = ProjectionExec(self.input, self._pre_exprs)
+        pre = self._pre_plan
         ops = [s.op for s in self.spec.slots]
 
         if n_groups == 0:
@@ -623,7 +628,7 @@ class HashAggregateExec(ExecutionPlan):
             states: list[DeviceBatch] = []
             for b in pre.execute(partition, ctx):
                 with self.metrics.time("agg_time"):
-                    states.append(self._scalar_state(b))
+                    states.append(self._scalar_state_fn()(b))
             if not states:
                 return
             merged = concat_batches(states) if len(states) > 1 else states[0]
@@ -697,6 +702,14 @@ class HashAggregateExec(ExecutionPlan):
         with self.metrics.time("agg_time"):
             yield fold(partials)
 
+    def _scalar_state_fn(self):
+        """Jitted per-batch scalar state (one program instead of eager
+        per-op dispatches — on a tunnelled chip each eager op is a
+        round trip)."""
+        if getattr(self, "_scalar_jit", None) is None:
+            self._scalar_jit = jax.jit(self._scalar_state)
+        return self._scalar_jit
+
     def _scalar_state(self, b: DeviceBatch) -> DeviceBatch:
         val_cols, val_nulls = [], []
         for s in self.spec.slots:
@@ -743,14 +756,28 @@ class HashAggregateExec(ExecutionPlan):
             return
         merge_ops = [s.op.merge_op for s in self.spec.slots]
         if n_groups == 0:
-            merged = concat_batches(states) if len(states) > 1 else states[0]
-            outs, nulls = scalar_aggregate(
-                merged.valid,
-                [merged.columns[i] for i in range(len(self.spec.slots))],
-                [merged.nulls[i] for i in range(len(self.spec.slots))],
-                merge_ops,
-            )
-            yield self._finalize_scalar(outs, nulls)
+            # one jitted program for merge-concat + scalar merge + final
+            # (eagerly this is ~15 separate dispatches — each a round
+            # trip on a tunnelled chip, dominating short queries)
+            if getattr(self, "_scalar_final_jit", None) is None:
+
+                def scalar_final(sts):
+                    merged = (
+                        concat_batches(sts) if len(sts) > 1 else sts[0]
+                    )
+                    outs, nulls = scalar_aggregate(
+                        merged.valid,
+                        [merged.columns[i]
+                         for i in range(len(self.spec.slots))],
+                        [merged.nulls[i]
+                         for i in range(len(self.spec.slots))],
+                        merge_ops,
+                    )
+                    return self._finalize_scalar(outs, nulls)
+
+                self._scalar_final_jit = jax.jit(scalar_final)
+            with self.metrics.time("merge_time"):
+                yield self._scalar_final_jit(states)
             return
         if len(states) == 1:
             # A single state batch comes from ONE partial output (partials

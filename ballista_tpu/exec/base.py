@@ -274,6 +274,10 @@ class Metrics:
     def add(self, name: str, v: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + v
 
+    def reset(self) -> None:
+        self.counters.clear()
+        self.timers.clear()
+
     def time(self, name: str):
         return _Timer(self, name)
 

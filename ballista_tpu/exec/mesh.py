@@ -131,7 +131,9 @@ class MeshAggregateExec(ExecutionPlan):
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
         from ballista_tpu.exec.pipeline import ProjectionExec
 
-        pre = ProjectionExec(self.input, self._pre_exprs)
+        if getattr(self, "_pre_plan", None) is None:
+            self._pre_plan = ProjectionExec(self.input, self._pre_exprs)
+        pre = self._pre_plan
         batch = self.runtime.place(pre, None, ctx)
         n_groups = len(self.spec.group_names)
 

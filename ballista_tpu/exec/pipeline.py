@@ -1,11 +1,15 @@
 """Row-pipeline operators: Filter, Projection, and batch-function fusion.
 
-Filter and Projection are pure per-batch device functions; each operator
-jits its function once and streams batches through. Because filters only
-clear validity bits and projections only swap column sets, XLA fuses a
-Filter->Projection->partial-Aggregate chain into one program when the
-distributed planner later compiles whole stages (SURVEY.md §7 "Stage DAG vs
-jit fusion boundary").
+Filter and Projection are pure per-batch device functions. The OUTERMOST
+operator of a Filter/Projection chain fuses the whole chain into ONE
+jitted program (``fusable_chain`` + ``fused_batch_fn``): on a tunnelled
+TPU every separate dispatch is a host round trip, so a q6-shaped plan
+(four pushed-down filter conjuncts + a measure projection) costs one
+program per batch instead of five (SURVEY.md §7 "Stage DAG vs jit fusion
+boundary"; the hot loop replaced is the per-batch stream in ref
+shuffle_writer.rs:214-256). Adaptive shrink runs ONCE on the fused
+output — seeing the chain's cumulative selectivity, which is strictly
+more informative than each filter's own.
 """
 
 from __future__ import annotations
@@ -21,7 +25,73 @@ from ballista_tpu.expr import logical as L
 from ballista_tpu.expr.physical import compile_expr
 
 
-class FilterExec(ExecutionPlan):
+def fusable_chain(plan: ExecutionPlan):
+    """(source, ops): the maximal Filter/Projection chain hanging off
+    ``plan``, ops innermost-first; source is the first non-fusable input."""
+    ops: list[ExecutionPlan] = []
+    p = plan
+    while isinstance(p, (FilterExec, ProjectionExec)):
+        ops.append(p)
+        p = p.input
+    ops.reverse()
+    return p, ops
+
+
+def fused_batch_fn(ops: list) -> Callable[[DeviceBatch], DeviceBatch]:
+    """One jitted program for the whole chain (inner jits inline when the
+    composition is traced)."""
+    fns = [op.batch_fn() for op in ops]
+    if len(fns) == 1:
+        return fns[0]
+
+    def run(batch: DeviceBatch) -> DeviceBatch:
+        for f in fns:
+            batch = f(batch)
+        return batch
+
+    return jax.jit(run)
+
+
+class _FusedPipeline:
+    """Shared execute() body for the outermost operator of a chain."""
+
+    _fused: tuple | None = None  # (source, fn, shrink_site, n_ops)
+
+    def _fused_parts(self):
+        if self._fused is None:
+            import os
+
+            if os.environ.get("BALLISTA_TPU_NO_FUSE"):
+                source, ops = self.input, [self]
+            else:
+                source, ops = fusable_chain(self)
+            fn = fused_batch_fn(ops)
+            # one shrink for the chain, at the OUTERMOST filter's site
+            # (stable identity for the learned-capacity cache)
+            shrink_site = next(
+                (o.display() for o in reversed(ops)
+                 if isinstance(o, FilterExec)),
+                None,
+            )
+            self._fused = (source, fn, shrink_site, len(ops))
+        return self._fused
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        from ballista_tpu.exec.shrink import maybe_shrink
+
+        source, fn, shrink_site, n_ops = self._fused_parts()
+        timer = "filter_time" if isinstance(self, FilterExec) else "project_time"
+        for b in source.execute(partition, ctx):
+            with self.metrics.time(timer):
+                out = fn(b)
+            self.metrics.add("input_batches")
+            self.metrics.counters["fused_ops"] = n_ops
+            if shrink_site is not None:
+                out = maybe_shrink(out, ctx, shrink_site, partition)
+            yield out
+
+
+class FilterExec(_FusedPipeline, ExecutionPlan):
     """ref: FilterExecNode (ballista.proto:457-460). Clears validity bits;
     no data movement (compaction is explicit where layout matters)."""
 
@@ -57,24 +127,7 @@ class FilterExec(ExecutionPlan):
             self._fn = jax.jit(run)
         return self._fn
 
-    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
-        from ballista_tpu.exec.shrink import maybe_shrink
-
-        fn = self.batch_fn()
-        site = None
-        for b in self.input.execute(partition, ctx):
-            with self.metrics.time("filter_time"):
-                out = fn(b)
-            self.metrics.add("input_batches")
-            # highly selective filters (q18's HAVING keeps ~60 of 1.5M
-            # groups) re-bucket to a learned small capacity so downstream
-            # sorts/gathers run at the data's true scale
-            if site is None:
-                site = self.display()
-            yield maybe_shrink(out, ctx, site, partition)
-
-
-class ProjectionExec(ExecutionPlan):
+class ProjectionExec(_FusedPipeline, ExecutionPlan):
     """ref: ProjectionExecNode (ballista.proto:441-444)."""
 
     def __init__(self, input: ExecutionPlan, exprs: list[L.Expr]) -> None:
@@ -129,13 +182,6 @@ class ProjectionExec(ExecutionPlan):
 
             self._fn = jax.jit(run)
         return self._fn
-
-    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
-        fn = self.batch_fn()
-        for b in self.input.execute(partition, ctx):
-            with self.metrics.time("project_time"):
-                out = fn(b)
-            yield out
 
 
 class CoalescePartitionsExec(ExecutionPlan):

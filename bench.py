@@ -260,7 +260,10 @@ def main() -> None:
         # CPU baseline is best-effort: a failure degrades vs_baseline to 0.
         # Same warm-iteration count as the device so best-of-N variance
         # treats both backends identically.
-        cpu_run = _run_child(env, ITERS, 3600, "cpu")
+        cpu_run = _run_child(
+            env, ITERS, int(os.environ.get("BENCH_CPU_TIMEOUT", 3600)),
+            "cpu",
+        )
 
     detail = {"device": device_run, "cpu": cpu_run}
     (HERE / "BENCH_DETAIL.json").write_text(json.dumps(detail, indent=2))

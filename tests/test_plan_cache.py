@@ -1,0 +1,71 @@
+"""Physical-plan cache correctness: repeated identical queries reuse the
+same operator instances (and therefore their jitted programs), while
+anything that would change results — differently-aliased expressions,
+re-registered sources, swapped data — must miss."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.csv as pacsv
+
+from ballista_tpu.exec.context import TpuContext
+
+
+def _ctx():
+    ctx = TpuContext()
+    t = pa.table({
+        "a": pa.array([1.0, 2.0, 3.0]),
+        "b": pa.array([10.0, 20.0, 30.0]),
+    })
+    ctx.register_table("t", t)
+    return ctx
+
+
+def test_identical_query_reuses_plan_and_resets_metrics():
+    ctx = _ctx()
+    df1 = ctx.sql("SELECT sum(a) AS x FROM t")
+    p1 = ctx.create_physical_plan(df1.logical)
+    df1.collect()
+    p2 = ctx.create_physical_plan(ctx.sql("SELECT sum(a) AS x FROM t").logical)
+    assert p1 is p2
+    # cache hit handed back fresh metrics, not run 1's accumulation
+    def counters(p):
+        out = dict(p.metrics.counters)
+        for c in p.children():
+            out.update(counters(c))
+        return out
+    assert not counters(p2)
+
+
+def test_same_alias_different_expr_does_not_collide():
+    """display() renders an aliased expr by its alias alone; the cache
+    key must still tell sum(a) AS x and sum(b) AS x apart."""
+    ctx = _ctx()
+    r1 = ctx.sql("SELECT sum(a) AS x FROM t").collect().to_pydict()
+    r2 = ctx.sql("SELECT sum(b) AS x FROM t").collect().to_pydict()
+    assert r1["x"] == [6.0]
+    assert r2["x"] == [60.0]
+
+
+def test_reregistering_csv_with_new_options_invalidates(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("a|b\n1|10\n2|20\n")
+    ctx = TpuContext()
+    # first registration parses the file as comma-separated: one column
+    ctx.register_csv("d", str(p), delimiter=",")
+    one_col = ctx.sql("SELECT * FROM d").collect()
+    assert one_col.num_columns == 1
+    # re-register with the right delimiter: same path, same mtime — the
+    # cached plan (and its captured parse options) must not be served
+    ctx.register_csv("d", str(p), delimiter="|")
+    two_col = ctx.sql("SELECT * FROM d").collect()
+    assert two_col.num_columns == 2
+    assert two_col.to_pydict()["a"] == [1, 2]
+
+
+def test_swapped_memory_table_invalidates():
+    ctx = _ctx()
+    assert ctx.sql("SELECT sum(a) AS x FROM t").collect().to_pydict()["x"] == [6.0]
+    ctx.register_table("t", pa.table({
+        "a": pa.array([5.0, 5.0]), "b": pa.array([0.0, 0.0]),
+    }))
+    assert ctx.sql("SELECT sum(a) AS x FROM t").collect().to_pydict()["x"] == [10.0]
