@@ -169,10 +169,13 @@ class TaskContext:
         raise ExecutionError(msg)
 
 
-# Hard ceiling for adaptive aggregate-capacity growth (groups). 8M groups x
-# ~8B per state column is comfortably within one chip's HBM; beyond it the
-# query needs a hash-repartitioned (multi-partition) aggregate instead.
-AGG_CAPACITY_HARD_MAX = 1 << 23
+# Hard ceiling for adaptive aggregate-capacity growth (groups). 32M groups
+# x ~8B per state column is a few hundred MB of state on a 16GB chip, and
+# the sort-based grouping's transients stay low-GB at that size — SF=100
+# q18 (60M distinct orderkeys per 4-way partition) is the sizing case.
+# Beyond it the query needs a hash-repartitioned (multi-partition)
+# aggregate instead.
+AGG_CAPACITY_HARD_MAX = 1 << 25
 
 # Guards the process-global JAX profiler (see run_with_capacity_retry).
 import threading as _threading  # noqa: E402

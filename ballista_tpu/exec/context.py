@@ -293,7 +293,10 @@ class TpuContext(Catalog, TableProvider):
                    self._data_version())
             cached = self._physical_cache.get(key)
             if cached is not None:
-                # metrics stay per-query, as with a fresh plan
+                # Metrics stay per-query, as with a fresh plan. (The
+                # returned instance is SHARED across identical queries:
+                # a caller holding it across another run of the same
+                # text sees that run's metrics, not a snapshot.)
                 def _reset(p):
                     p.metrics.reset()
                     for c in p.children():
@@ -301,6 +304,12 @@ class TpuContext(Catalog, TableProvider):
 
                 _reset(cached)
                 return cached
+            if len(self._physical_cache) >= 128:
+                # parameterized query streams (distinct literals per
+                # request) must not retain operator trees + compiled
+                # programs without bound; dropping everything is fine —
+                # a re-plan costs ~ms and recompiles hit the XLA cache
+                self._physical_cache.clear()
         partitions = self.config.default_shuffle_partitions()
         phys = PhysicalPlanner(
             self, partitions, mesh_runtime=self.mesh_runtime()

@@ -306,3 +306,54 @@ class MeshJoinExec(ExecutionPlan):
             if d is not None:
                 dicts[f.name] = d
         return dicts
+
+
+class MeshSortExec(ExecutionPlan):
+    """ORDER BY ... LIMIT as a distributed TopK over the mesh (local
+    top-k per shard -> all_gather over ICI -> replicated merge), replacing
+    the CoalescePartitions -> SortExec funnel when a fetch bound exists.
+    The stage boundary it replaces is the reference's single-task sort
+    after a gather (ref scheduler planner.rs:104-132 coalesce split);
+    semantics mirror SortExec's fetch path (exec/sort.py)."""
+
+    def __init__(
+        self,
+        input: ExecutionPlan,
+        sort_exprs,
+        fetch: int,
+        runtime: MeshRuntime,
+    ) -> None:
+        from ballista_tpu.ops.sort import resolve_sort_keys
+
+        super().__init__()
+        if fetch is None or fetch <= 0:
+            raise PlanError("mesh sort requires a positive fetch bound")
+        self.input = input
+        self.sort_exprs = list(sort_exprs)
+        self.fetch = fetch
+        self.runtime = runtime
+        self._keys = resolve_sort_keys(input.schema(), self.sort_exprs)
+
+    def schema(self) -> Schema:
+        return self.input.schema()
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def output_partitioning(self):
+        return UnknownPartitioning(1)
+
+    def describe(self) -> str:
+        ks = ", ".join(
+            f"{s.expr.name()} {'ASC' if s.ascending else 'DESC'}"
+            for s in self.sort_exprs
+        )
+        return (
+            f"MeshSortExec(ici-all_gather): [{ks}], fetch={self.fetch}"
+        )
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        batch = self.runtime.place(self.input, None, ctx)
+        with self.metrics.time("sort_time"):
+            out = self.runtime.runner.topk(batch, self._keys, self.fetch)
+        yield out

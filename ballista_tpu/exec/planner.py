@@ -180,6 +180,32 @@ class PhysicalPlanner:
                 child = CoalescePartitionsExec(child)
             return SortExec(child, list(node.sort_exprs))
         if isinstance(node, P.Limit):
+            # ORDER BY + LIMIT over the mesh: distributed TopK (local
+            # top-k per shard -> all_gather -> replicated merge) instead
+            # of gathering everything to one device and sorting there
+            if (
+                self.mesh_runtime is not None
+                and node.fetch is not None
+                and isinstance(node.input, P.Sort)
+            ):
+                from ballista_tpu.exec.mesh import MeshSortExec
+
+                sort_node = node.input
+                child = self._plan(sort_node.input)
+                try:
+                    ms = MeshSortExec(
+                        child, list(sort_node.sort_exprs),
+                        node.skip + node.fetch, self.mesh_runtime,
+                    )
+                    return GlobalLimitExec(ms, node.skip, node.fetch)
+                except PlanError:
+                    # non-column keys etc.: the funnel below still works
+                    if child.output_partitioning().n > 1:
+                        child = CoalescePartitionsExec(child)
+                    return GlobalLimitExec(
+                        SortExec(child, list(sort_node.sort_exprs)),
+                        node.skip, node.fetch,
+                    )
             child = self._plan(node.input)
             if child.output_partitioning().n > 1:
                 child = CoalescePartitionsExec(child)

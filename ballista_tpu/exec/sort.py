@@ -50,21 +50,11 @@ class SortExec(ExecutionPlan):
         self.sort_exprs = list(sort_exprs)
         self.fetch = fetch
         self._fn = None
-        ins = input.schema()
-        self._keys: list[SortKey] = []
-        for s in self.sort_exprs:
-            if not isinstance(s.expr, L.Column):
-                raise PlanError(
-                    "SortExec requires column sort keys (planner projects "
-                    "expressions first)"
-                )
-            self._keys.append(
-                SortKey(
-                    col=L.resolve_field_index(ins, s.expr.cname),
-                    ascending=s.ascending,
-                    nulls_first=s.nulls_first,
-                )
-            )
+        from ballista_tpu.ops.sort import resolve_sort_keys
+
+        self._keys: list[SortKey] = resolve_sort_keys(
+            input.schema(), self.sort_exprs
+        )
 
     def schema(self) -> Schema:
         return self.input.schema()

@@ -33,31 +33,51 @@ class SortKey:
     nulls_first: bool = False
 
 
-@functools.lru_cache(maxsize=None)
-def _invert_program(cap: int):
-    return jax.jit(lambda v: ~v)
+def resolve_sort_keys(schema, sort_exprs) -> list["SortKey"]:
+    """ORDER BY terms -> SortKeys; raises PlanError for non-column keys
+    (the planner projects expressions first). Shared by SortExec and the
+    mesh TopK so key semantics cannot drift."""
+    from ballista_tpu.errors import PlanError
+    from ballista_tpu.expr import logical as L
+
+    keys = []
+    for s in sort_exprs:
+        if not isinstance(s.expr, L.Column):
+            raise PlanError(
+                "sort requires column sort keys (planner projects "
+                "expressions first)"
+            )
+        keys.append(
+            SortKey(
+                col=L.resolve_field_index(schema, s.expr.cname),
+                ascending=s.ascending,
+                nulls_first=s.nulls_first,
+            )
+        )
+    return keys
 
 
-@functools.lru_cache(maxsize=None)
-def _null_place_program(cap: int, nulls_first: bool):
-    # 0 sorts before 1: nulls_first -> nulls get 0.
-    return jax.jit(lambda nm: nm != nulls_first)
+def sort_passes(cols, nulls, valid, keys: list["SortKey"]):
+    """The (column, descending) pass list realizing SortKey semantics:
+    invalid rows last, then per key a null-placement pass and the key
+    itself. The single source of truth for sort ordering — sort_perm and
+    the mesh TopK program both build on it. Operates on raw sequences so
+    it can run inside a traced (shard_map) context."""
+    passes = [(~valid, False)]
+    for k in keys:
+        nm = nulls[k.col]
+        if nm is not None:
+            # 0 sorts before 1: nulls_first -> nulls get 0
+            passes.append((nm != k.nulls_first, False))
+        passes.append((cols[k.col], not k.ascending))
+    return passes
 
 
 def sort_perm(batch: DeviceBatch, keys: list[SortKey]) -> jnp.ndarray:
     """The sorting permutation for ``keys`` (invalid rows last)."""
-    cap = batch.capacity
-    passes: list[tuple[jnp.ndarray, bool]] = [
-        (_invert_program(cap)(batch.valid), False)  # invalid rows last
-    ]
-    for k in keys:
-        nm = batch.nulls[k.col]
-        if nm is not None:
-            passes.append(
-                (_null_place_program(cap, k.nulls_first)(nm), False)
-            )
-        passes.append((batch.columns[k.col], not k.ascending))
-    return multi_key_perm(passes)
+    return multi_key_perm(
+        sort_passes(batch.columns, batch.nulls, batch.valid, keys)
+    )
 
 
 def gather_batch(batch: DeviceBatch, perm: jnp.ndarray) -> DeviceBatch:

@@ -238,6 +238,93 @@ class MeshStageRunner:
         )
         return jax.jit(sm)
 
+    # -- distributed TopK -----------------------------------------------------
+    def topk(self, batch: DeviceBatch, keys, k: int) -> DeviceBatch:
+        """ORDER BY ... LIMIT k as one mesh program: local sort + top-k on
+        each shard, ``all_gather`` of the k-row candidates over ICI, final
+        merge sort of the k*n_dev pool — every device computes the same
+        replicated answer (SPMD), so the output is a single logical
+        partition with no host hop. The shard-local top-k bounds the
+        gather to k*n_dev rows regardless of input size (the mesh
+        analogue of SortExec's fetch-sliced permutation)."""
+        key_sig = tuple(
+            (kk.col, kk.ascending, kk.nulls_first) for kk in keys
+        )
+        prog = self._topk_program(batch, key_sig, k)
+        out_cols, out_nulls, out_valid = prog(
+            batch.columns, batch.nulls, batch.valid
+        )
+        return DeviceBatch(
+            schema=batch.schema,
+            columns=tuple(out_cols),
+            valid=out_valid,
+            nulls=tuple(out_nulls),
+            dictionaries=dict(batch.dictionaries),
+        )
+
+    def _topk_program(self, batch, key_sig, k):
+        key = (
+            "topk", str(batch.schema), batch.capacity, key_sig, k,
+            tuple(m is None for m in batch.nulls),
+        )
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._compile_topk(batch, key_sig, k)
+            self._programs[key] = prog
+        return prog
+
+    def _compile_topk(self, batch, key_sig, k):
+        from ballista_tpu.ops.perm import take_batch
+        from ballista_tpu.ops.sort import SortKey, sort_passes
+
+        axis = self.axis
+        keys = [
+            SortKey(col=c, ascending=a, nulls_first=nf)
+            for c, a, nf in key_sig
+        ]
+
+        def local_topk(cols, nulls, valid, kk):
+            # same pass construction as single-device sort_perm — shared
+            # so mesh TopK order cannot drift from SortExec order
+            perm = multi_key_perm(sort_passes(cols, nulls, valid, keys))[:kk]
+            return take_batch(list(cols), list(nulls), valid, perm)
+
+        def f(cols, nulls, valid):
+            shard_k = min(k, cols[0].shape[0])
+            tcols, tnulls, tvalid = local_topk(cols, nulls, valid, shard_k)
+
+            def ag(x):
+                return jax.lax.all_gather(x, axis, tiled=True)
+
+            gcols = tuple(ag(c) for c in tcols)
+            gnulls = tuple(None if m is None else ag(m) for m in tnulls)
+            gvalid = ag(tvalid)
+            fk = min(k, gcols[0].shape[0])
+            ocols, onulls, ovalid = local_topk(gcols, gnulls, gvalid, fk)
+            out_nulls = tuple(
+                jnp.zeros(c.shape[0], dtype=bool) if m is None else m
+                for c, m in zip(ocols, onulls)
+            )
+            return tuple(ocols), out_nulls, ovalid
+
+        in_specs = (
+            self._leaf_specs(batch.columns),
+            self._leaf_specs(batch.nulls),
+            P(axis),
+        )
+        n = len(batch.columns)
+        # replicated outputs: every device computed the identical answer
+        out_specs = (
+            tuple(P() for _ in range(n)),
+            tuple(P() for _ in range(n)),
+            P(),
+        )
+        sm = shard_map(
+            f, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+        return jax.jit(sm)
+
     # -- partitioned join -----------------------------------------------------
     def join(
         self,

@@ -166,3 +166,34 @@ np.testing.assert_allclose(got.s, want.s, rtol=1e-9)
 print("MESH-SQL-STR-OK")
 """)
     assert "MESH-SQL-STR-OK" in out
+
+
+def test_sql_order_by_limit_runs_as_mesh_topk():
+    out = run_script(r"""
+n = 40000
+t = pa.table({"k": pa.array(rng.integers(0, 1000, n)),
+              "v": pa.array(rng.uniform(0, 100, n)),
+              "d": pa.array(rng.integers(0, 3650, n).astype(np.int32))})
+ctx.register_table("t", t)
+sql = ("SELECT k, SUM(v) AS s FROM t GROUP BY k "
+       "ORDER BY s DESC, k ASC LIMIT 7")
+disp = physical_display(sql)
+assert "MeshSortExec" in disp, disp
+assert "CoalescePartitionsExec" not in disp, disp
+got = ctx.sql(sql).collect().to_pandas()
+df = t.to_pandas()
+want = (df.groupby("k").v.sum().reset_index(name="s")
+          .sort_values(["s", "k"], ascending=[False, True]).head(7))
+np.testing.assert_array_equal(got.k.values, want.k.values)
+np.testing.assert_allclose(got.s.values, want.s.values, rtol=1e-9)
+
+# skip + fetch through the same path
+sql2 = "SELECT k, v FROM t ORDER BY v DESC LIMIT 5 OFFSET 3"
+disp2 = physical_display(sql2)
+assert "MeshSortExec" in disp2, disp2
+got2 = ctx.sql(sql2).collect().to_pandas()
+want2 = df.sort_values("v", ascending=False).iloc[3:8]
+np.testing.assert_allclose(got2.v.values, want2.v.values, rtol=1e-12)
+print("MESH-TOPK-OK")
+""")
+    assert "MESH-TOPK-OK" in out
