@@ -209,6 +209,144 @@ def _ones_program(cap: int):
     return jax.jit(lambda: jnp.ones(cap, dtype=jnp.int64))
 
 
+# -- disjoint clustered states (the streaming wide-cardinality path) ---------
+#
+# A GROUP BY over an input CLUSTERED on an integer key (TPC-H lineitem by
+# l_orderkey) produces per-batch partial states whose key RANGES are
+# disjoint except for at most the one group spanning each batch boundary.
+# Folding such states through the generic merge is quadratic in the number
+# of live groups (each incremental fold re-sorts everything seen so far —
+# at SF=10 q18 that is 15M groups and ~60s/run). Instead: trim the shared
+# boundary group into the previous state, keep every state as-is, and let
+# the final stage finalize each state independently after a cheap
+# range-disjointness check. No merge at any capacity ever runs.
+# (DataFusion's analogue is its order-aware streaming aggregate.)
+
+_INT_KEY_DTYPES = (
+    DataType.INT32, DataType.INT64, DataType.DATE32, DataType.TIMESTAMP_US,
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _bounds_program(cap: int, dtype: str, has_null_mask: bool):
+    """(min live key, max live key, live count, has-null-key-group) for a
+    single-int-key state — order-independent (reduction, not prefix
+    peek). The null flag matters because group_aggregate stores the
+    NULL-key group with the key column ZEROED + a null mask: its bounds
+    would alias a real key-0 group, so a state carrying one must leave
+    the disjoint path."""
+
+    def f(key_col, valid, key_nulls):
+        n = jnp.sum(valid).astype(jnp.int32)
+        big = jnp.iinfo(key_col.dtype).max
+        kmin = jnp.min(jnp.where(valid, key_col, big))
+        kmax = jnp.max(jnp.where(valid, key_col, -big - 1))
+        if has_null_mask:
+            has_null = jnp.any(valid & key_nulls)
+        else:
+            has_null = jnp.zeros((), dtype=bool)
+        return kmin, kmax, n, has_null
+
+    return jax.jit(f)
+
+
+def _state_bounds_dev(st: DeviceBatch):
+    """Device bounds tuple for a state's key column (see
+    _bounds_program)."""
+    kcol = st.columns[0]
+    knl = st.nulls[0]
+    return _bounds_program(
+        st.capacity, str(kcol.dtype), knl is not None
+    )(kcol, st.valid, knl if knl is not None else st.valid)
+
+
+def _slice_state(st: DeviceBatch, n: int) -> DeviceBatch:
+    """Slice a front-compacted state down to its live prefix capacity (a
+    free device slice — no compaction pass)."""
+    from ballista_tpu.columnar.batch import round_capacity
+
+    newcap = round_capacity(max(int(n), 16))
+    if newcap >= st.capacity:
+        return st
+    return DeviceBatch(
+        schema=st.schema,
+        columns=tuple(c[:newcap] for c in st.columns),
+        valid=st.valid[:newcap],
+        nulls=tuple(m if m is None else m[:newcap] for m in st.nulls),
+        dictionaries=dict(st.dictionaries),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _boundary_merge_program(
+    merge_ops: tuple, prev_sig: tuple, next_sig: tuple,
+    prev_nulls_sig: tuple, next_nulls_sig: tuple,
+    prev_cap: int, next_cap: int,
+):
+    """Merge the ONE group shared by two otherwise-disjoint states: fold
+    next's row for ``key`` into prev's row for ``key`` with the slot
+    merge ops (SUM/MIN/MAX, null = 'no values seen'), then kill next's
+    row. Element updates only — no sort, no capacity growth."""
+
+    def merge_val(op: AggOp, a, a_nl, b, b_nl):
+        if op == AggOp.SUM:
+            v = jnp.where(a_nl, b, jnp.where(b_nl, a, a + b))
+        elif op == AggOp.MIN:
+            v = jnp.where(a_nl, b, jnp.where(b_nl, a, jnp.minimum(a, b)))
+        else:  # MAX (COUNT merges as SUM)
+            v = jnp.where(a_nl, b, jnp.where(b_nl, a, jnp.maximum(a, b)))
+        return v, a_nl & b_nl
+
+    def f(prev_cols, prev_nulls, prev_valid, next_cols, next_nulls,
+          next_valid, key):
+        ip = jnp.argmax(prev_valid & (prev_cols[0] == key))
+        inx = jnp.argmax(next_valid & (next_cols[0] == key))
+        out_cols, out_nulls = [prev_cols[0]], [prev_nulls[0]]
+        for j, op in enumerate(merge_ops):
+            c = j + 1  # state layout: key, then slot columns
+            a, b = prev_cols[c][ip], next_cols[c][inx]
+            a_nl = (
+                prev_nulls[c][ip] if prev_nulls[c] is not None
+                else jnp.zeros((), dtype=bool)
+            )
+            b_nl = (
+                next_nulls[c][inx] if next_nulls[c] is not None
+                else jnp.zeros((), dtype=bool)
+            )
+            v, nl = merge_val(op, a, a_nl, b, b_nl)
+            out_cols.append(prev_cols[c].at[ip].set(v.astype(prev_cols[c].dtype)))
+            out_nulls.append(
+                None if prev_nulls[c] is None
+                else prev_nulls[c].at[ip].set(nl)
+            )
+        nx_valid = next_valid.at[inx].set(False)
+        return tuple(out_cols), tuple(out_nulls), nx_valid
+
+    return jax.jit(f)
+
+
+def _merge_boundary(
+    prev: DeviceBatch, nxt: DeviceBatch, merge_ops: tuple, key: int
+) -> tuple[DeviceBatch, DeviceBatch]:
+    prog = _boundary_merge_program(
+        merge_ops,
+        tuple(str(c.dtype) for c in prev.columns),
+        tuple(str(c.dtype) for c in nxt.columns),
+        tuple(m is None for m in prev.nulls),
+        tuple(m is None for m in nxt.nulls),
+        prev.capacity, nxt.capacity,
+    )
+    p_cols, p_nulls, nx_valid = prog(
+        prev.columns, prev.nulls, prev.valid,
+        nxt.columns, nxt.nulls, nxt.valid, key,
+    )
+    return (
+        DeviceBatch(schema=prev.schema, columns=p_cols, valid=prev.valid,
+                    nulls=p_nulls, dictionaries=dict(prev.dictionaries)),
+        nxt.with_valid(nx_valid),
+    )
+
+
 @functools.lru_cache(maxsize=None)
 def _state_batch_program(dtypes: tuple):
     """GroupAggResult -> state-shaped DeviceBatch with target dtypes (one
@@ -650,24 +788,97 @@ class HashAggregateExec(ExecutionPlan):
                 from_state=True, ctx=ctx, site=site + "|fold",
             )
 
-        # Fold incrementally: a wide-cardinality aggregate's per-batch
-        # states are capacity-sized device arrays, and holding one per
-        # input batch OOMs HBM at scale (SF=10 lineitem = ~30 batches x a
-        # multi-M-row group capacity blew a 16GB chip). Folding every few
-        # batches bounds live states to _FOLD_WIDTH at the cost of
-        # re-merging already-folded groups (merge ops are associative).
+        # Disjoint-clustered fast path: single int key and per-batch
+        # state ranges that never overlap (clustered source). States are
+        # kept individually (sliced to their live prefix), the one
+        # boundary-spanning group is trimmed into the previous state, and
+        # NO fold ever runs — the final stage sees range-disjoint states
+        # and finalizes each independently. The per-state bounds fetch
+        # doubles as pipeline backpressure.
+        disjoint = (
+            n_groups == 1
+            and self._schema.fields[0].dtype in _INT_KEY_DTYPES
+        )
+        merge_ops_t = tuple(merge_ops)
+        prev_last = None
+
+        # Fold incrementally (the general path): a wide-cardinality
+        # aggregate's per-batch states are capacity-sized device arrays,
+        # and holding one per input batch OOMs HBM at scale (SF=10
+        # lineitem = ~30 batches x a multi-M-row group capacity blew a
+        # 16GB chip). Folding every few batches bounds live states to
+        # _FOLD_WIDTH at the cost of re-merging already-folded groups
+        # (merge ops are associative).
+        def settle(entry) -> bool:
+            """Resolve one queued (state, device-bounds) pair and fold it
+            into ``partials`` under the disjoint rules. Returns False on
+            a range overlap (caller reverts to the fold discipline)."""
+            nonlocal prev_last
+            from ballista_tpu.ops.fetch import fetch_arrays
+
+            st, dev = entry
+            first, last, n, has_null = (
+                int(v) for v in fetch_arrays(list(dev))
+            )
+            if n == 0:
+                return True
+            st = _slice_state(st, n)
+            if has_null:
+                # a NULL-key group rides with key 0 + a null mask; its
+                # bounds alias a real key-0 group — disqualify the batch
+                self.metrics.add("disjoint_break")
+                partials.append(st)
+                return False
+            if prev_last is None or first > prev_last:
+                partials.append(st)
+                prev_last = last
+            elif first == prev_last and partials:
+                pm, st2 = _merge_boundary(
+                    partials[-1], st, merge_ops_t, first
+                )
+                partials[-1] = pm
+                if n > 1:
+                    partials.append(st2)
+                    prev_last = last
+                self.metrics.add("boundary_trims")
+            else:
+                # ranges overlap: not clustered
+                self.metrics.add("disjoint_break")
+                partials.append(st)
+                return False
+            return True
+
+        pending = None  # lag-1 bounds resolution: overlap the round trip
         for b in pre.execute(partition, ctx):
             with self.metrics.time("agg_time"):
                 # per-batch states come out at min(cap, batch capacity)
                 # (_run_group_agg clamps internally) — a batch of N rows
                 # holds at most N groups
-                partials.append(
-                    self._run_group_agg(
-                        b, ops, n_groups, cap, from_state=False, ctx=ctx,
-                        site=site,
-                    )
+                st = self._run_group_agg(
+                    b, ops, n_groups, cap, from_state=False, ctx=ctx,
+                    site=site,
                 )
-                if len(partials) >= self._FOLD_WIDTH:
+                if disjoint:
+                    dev = _state_bounds_dev(st)
+                    for a in dev:
+                        try:
+                            a.copy_to_host_async()
+                        except Exception:
+                            pass
+                    # settle the PREVIOUS batch's bounds while this
+                    # batch's pipeline is still in flight — the blocking
+                    # fetch doubles as pipeline backpressure
+                    if pending is not None and not settle(pending):
+                        disjoint = False
+                    pending = (st, dev)
+                    if not disjoint:
+                        # overlap detected: drain the queued entry and
+                        # revert to the fold discipline
+                        settle(pending)
+                        pending = None
+                else:
+                    partials.append(st)
+                if not disjoint and len(partials) >= self._FOLD_WIDTH:
                     partials = [fold(partials)]
                     # BACKPRESSURE: dispatch on this platform is fully
                     # async (block_until_ready is a no-op over the
@@ -692,10 +903,19 @@ class HashAggregateExec(ExecutionPlan):
                         _np.asarray(bp_prev)
                     bp_prev = flag
             self.metrics.add("input_batches")
+        if pending is not None:
+            with self.metrics.time("agg_time"):
+                settle(pending)
         if not partials:
             return
         if len(partials) == 1:
             yield partials[0]
+            return
+        if disjoint:
+            # range-disjoint states: nothing shares a key, no fold needed
+            # (the final stage re-checks disjointness before skipping its
+            # merge, so this emission is safe under any consumer)
+            yield from partials
             return
         # final fold of this partition's remaining states (bounds shuffle
         # volume: one folded state leaves the partition)
@@ -795,6 +1015,67 @@ class HashAggregateExec(ExecutionPlan):
                 out = self._finalize(states[0], n_groups)
             yield out
             return
+        if (
+            n_groups == 1
+            and self._schema.fields[0].dtype in _INT_KEY_DTYPES
+        ):
+            # Range-disjoint states (the clustered partial emission, or
+            # any shuffle layout that happens to partition cleanly):
+            # finalize each state independently — the merge would re-sort
+            # every group only to rediscover that nothing overlaps. One
+            # batched bounds fetch decides; overlap falls through to the
+            # general merge, so this is an optimization, never a
+            # correctness assumption.
+            from ballista_tpu.ops.fetch import fetch_arrays
+
+            raw = []
+            for st in states:
+                raw.extend(_state_bounds_dev(st))
+            vals = [int(v) for v in fetch_arrays(raw)]
+            bounds = [
+                (vals[4 * i], vals[4 * i + 1], vals[4 * i + 2],
+                 vals[4 * i + 3])
+                for i in range(len(states))
+            ]
+            live = sorted(
+                (b for b in zip(bounds, states) if b[0][2] > 0),
+                key=lambda p: p[0][0],
+            )
+            # exactly-touching ranges (a group split across two upstream
+            # partitions) are trimmed here the same way the partial trims
+            # its batch boundaries; only a real overlap — or any state
+            # carrying a NULL-key group (stored as key 0 + null mask,
+            # aliasing a real key-0 group) — forces the merge
+            if not any(b[0][3] for b in live) and all(
+                a[0][1] <= b[0][0] for a, b in zip(live, live[1:])
+            ):
+                merge_ops_t = tuple(merge_ops)
+                with self.metrics.time("merge_time"):
+                    out_states = []
+                    for (lo, hi, n, _hn), st in live:
+                        if out_states and out_states[-1][0][1] == lo:
+                            pm, st = _merge_boundary(
+                                out_states[-1][1], st, merge_ops_t, lo
+                            )
+                            out_states[-1] = (out_states[-1][0], pm)
+                            self.metrics.add("boundary_trims")
+                            if n == 1:
+                                continue
+                        out_states.append(((lo, hi, n), st))
+                    self.metrics.add("final_disjoint_skip")
+                    # group keys are globally unique across the disjoint
+                    # states, so ONE concat + ONE finalize replaces a
+                    # per-state finalize (whose varying sliced shapes
+                    # would each trace their own program) — and the
+                    # downstream pipeline sees a single batch
+                    merged = (
+                        out_states[0][1]
+                        if len(out_states) == 1
+                        else concat_batches([st for _, st in out_states])
+                    )
+                    yield self._finalize(merged, n_groups)
+                return
+            self.metrics.add("final_disjoint_miss")
         site = self.display()
         states = self._slice_states(states, ctx, site, partition)
         merged = concat_batches(states)

@@ -265,6 +265,24 @@ def run_with_capacity_retry(
             ):
                 raise
             override = new_cap
+        except Exception as e:
+            # Tunnelled-TPU compile-service flakiness: a long XLA compile
+            # sometimes drops mid-response ("remote_compile: read body:
+            # response body closed..."). The compile is stateless and the
+            # retry usually succeeds (partial results land in the compile
+            # cache), so re-dispatch a bounded number of times rather
+            # than failing a 10-minute query on a transport hiccup.
+            if (
+                type(e).__name__ == "JaxRuntimeError"
+                and "remote_compile" in str(e)
+            ):
+                ctx.deferred_checks.clear()
+                ctx.speculative_checks.clear()
+                spec_misses += 1  # shares the bounded-retry counter
+                if spec_misses > 3:
+                    raise
+                continue
+            raise
 
 
 class Metrics:

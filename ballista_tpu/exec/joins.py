@@ -277,6 +277,70 @@ class HashJoinExec(ExecutionPlan):
         expansion); if BOTH sides have duplicates, run the m:n expansion
         join with the right side as build."""
         ls, rs = self.left.schema(), self.right.schema()
+        cache0 = ctx.plan_cache
+        key_strings = any(
+            ls.fields[i].dtype == DataType.STRING for i in left_keys
+        ) or any(rs.fields[i].dtype == DataType.STRING for i in right_keys)
+        if cache0 is not None and not key_strings:
+            # Cached-flip fast path: when prior runs LEARNED that the
+            # right side cannot serve as a unique build (dups/overflow)
+            # and the left CAN, skip collecting the right entirely —
+            # collecting a 60M-row fact side, concat-ing it, and sorting
+            # it for a strategy decision we already know was >200s/run of
+            # SF=10 q18. Int keys need no dictionary unification, so the
+            # collected right was ONLY the decision input. The left's
+            # uniqueness is still deferred-validated (stale -> retry via
+            # the general path); the right's "has dups" bit needs NO
+            # validation — a unique-left build probe is correct whether
+            # or not the probe side has duplicates.
+            rflags = cache0.get(self._strategy_key(self.right, right_keys, ctx))
+            lfp = self._strategy_key(self.left, left_keys, ctx)
+            lflags = cache0.get(lfp)
+            if (
+                rflags is not None
+                and (rflags[0] or rflags[1])
+                and lflags is not None
+                and not lflags[0]
+                and not lflags[1]
+            ):
+                if partition != 0:
+                    return
+                from ballista_tpu.exec.shrink import maybe_shrink
+
+                with self.metrics.time("build_time"):
+                    left_batch = _collect(self.left, ctx)
+                    lbt = build_side(left_batch, left_keys)
+                ctx.defer_speculation(
+                    lbt.spec_flag(),
+                    "cached join build strategy went stale (flip side "
+                    "no longer unique)",
+                    [lfp, ("join_lut", lfp)],
+                )
+                contig = self._contig_probe(lbt, lflags, True, ctx, lfp)
+                site = self.display()
+                rpart = self.right.output_partitioning()
+                for p in range(rpart.n):
+                    for b in self.right.execute(p, ctx):
+                        if not contig:
+                            # per-batch: the general path gates the LUT
+                            # on the COLLECTED probe capacity, which the
+                            # stream never materializes — re-offering
+                            # each batch converges to the same decision
+                            # (the helper early-outs once attached or
+                            # once the domain is learned unusable)
+                            self._maybe_attach_lut(
+                                lbt, b.capacity, ctx, lfp
+                            )
+                        joined = self._probe_with_filter(
+                            lbt, b, right_keys, JoinSide.INNER, contig
+                        )
+                        out = self._restore_column_order(
+                            joined, b, lbt.batch, build_is_right=False
+                        )
+                        self.metrics.add("output_batches")
+                        yield maybe_shrink(out, ctx, site, 0)
+                return
+
         with self.metrics.time("build_time"):
             right_batch = _collect(self.right, ctx)
 

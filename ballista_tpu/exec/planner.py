@@ -199,13 +199,9 @@ class PhysicalPlanner:
                     )
                     return GlobalLimitExec(ms, node.skip, node.fetch)
                 except PlanError:
-                    # non-column keys etc.: the funnel below still works
-                    if child.output_partitioning().n > 1:
-                        child = CoalescePartitionsExec(child)
-                    return GlobalLimitExec(
-                        SortExec(child, list(sort_node.sort_exprs)),
-                        node.skip, node.fetch,
-                    )
+                    pass  # non-column keys / fetch 0: the canonical
+                    # P.Sort lowering below handles it (re-plans the
+                    # sort input; planning is side-effect free)
             child = self._plan(node.input)
             if child.output_partitioning().n > 1:
                 child = CoalescePartitionsExec(child)
