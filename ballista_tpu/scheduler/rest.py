@@ -41,7 +41,7 @@ _UI_PAGE = """<!doctype html>
 </tr></thead><tbody></tbody></table>
 <h2>Jobs</h2>
 <table id="jobs"><thead><tr>
- <th>job id</th><th>status</th><th>stages</th><th>error</th>
+ <th>job id</th><th>status</th><th>stages</th><th>tasks (done/total)</th><th>stage detail</th><th>error</th>
 </tr></thead><tbody></tbody></table>
 <script>
 // textContent only — job errors echo user SQL fragments, never as HTML
@@ -67,7 +67,18 @@ async function refresh() {
   }
   const jb = document.querySelector('#jobs tbody'); jb.innerHTML = '';
   for (const j of s.jobs) {
-    row(jb, [j.job_id, j.status, j.n_stages, j.error || '']);
+    const stages = j.stages || [];
+    let done = 0, total = 0;
+    const detail = stages.map(st => {
+      done += st.tasks.completed; total += st.n_tasks;
+      return `s${st.stage_id}:${st.state}` +
+        (st.state === 'running'
+          ? ` (${st.tasks.completed}/${st.n_tasks})` : '');
+    }).join('  ');
+    // finished jobs have their stage bookkeeping torn down — no counts
+    row(jb, [j.job_id, j.status, j.n_stages,
+             stages.length ? `${done} / ${total}` : '-',
+             detail, j.error || '']);
   }
 }
 refresh(); setInterval(refresh, 2000);
@@ -107,6 +118,9 @@ def scheduler_state(server) -> dict:
             "status": j.status,
             "n_stages": len(j.stages),
             "error": j.error,
+            # per-stage DAG state + task counts (the reference UI's job
+            # detail view; ref ballista/ui job/stage tables)
+            "stages": server.stage_manager.job_stage_summary(j.job_id),
         }
         for j in job_snapshot
     ]

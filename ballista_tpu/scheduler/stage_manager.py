@@ -299,6 +299,33 @@ class StageManager:
                         out.append(PartitionId(job_id, stage_id, i))
         return out
 
+    def job_stage_summary(self, job_id: str) -> list[dict]:
+        """Read-only per-stage snapshot for the REST /api/state payload:
+        stage id, DAG state, and task-state counts (ref ui job detail)."""
+        with self._lock:
+            out = []
+            keys = sorted(k for k in self._stages if k[0] == job_id)
+            for key in keys:
+                _, sid = key
+                stage = self._stages[key]
+                state = (
+                    "completed" if key in self._completed
+                    else "running" if key in self._running
+                    else "pending"
+                )
+                counts = stage.counts()
+                out.append(
+                    {
+                        "stage_id": sid,
+                        "state": state,
+                        "n_tasks": stage.n_tasks,
+                        "tasks": {
+                            s.value: n for s, n in counts.items()
+                        },
+                    }
+                )
+            return out
+
     def has_running_tasks(self) -> bool:
         with self._lock:
             return any(

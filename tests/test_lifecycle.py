@@ -174,6 +174,9 @@ print("ENTRYPOINT-OK")
     assert len(state["executors"]) == 1
     assert state["executors"][0]["total_task_slots"] == 4
     assert any(j["status"] == "completed" for j in state["jobs"]), state
+    # every job row carries the per-stage detail array (finished jobs
+    # have their stage bookkeeping torn down, so it may be empty)
+    assert all("stages" in j for j in state["jobs"]), state
 
     # the UI page serves
     page = urllib.request.urlopen(

@@ -148,3 +148,21 @@ def test_remove_job_stages_clears_everything():
     assert not sm.is_pending_stage("a", 2)
     assert sm.inflight_tasks() == 1  # job b untouched
     assert sm.fetch_schedulable_stage() == ("b", 1)
+
+
+def test_job_stage_summary_snapshot():
+    sm = StageManager()
+    sm.add_running_stage("j1", 1, 3)
+    sm.add_pending_stage("j1", 2, 2)
+    sm.add_running_stage("other", 1, 1)  # different job: excluded
+    sm.update_task_status(PartitionId("j1", 1, 0), TaskState.RUNNING, "e1")
+    sm.update_task_status(
+        PartitionId("j1", 1, 0), TaskState.COMPLETED, "e1", partitions=[]
+    )
+    summary = sm.job_stage_summary("j1")
+    assert [s["stage_id"] for s in summary] == [1, 2]
+    s1, s2 = summary
+    assert s1["state"] == "running" and s1["n_tasks"] == 3
+    assert s1["tasks"]["completed"] == 1 and s1["tasks"]["pending"] == 2
+    assert s2["state"] == "pending"
+    assert s2["tasks"]["pending"] == 2
