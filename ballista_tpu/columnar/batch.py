@@ -177,13 +177,18 @@ class DeviceBatch:
         )
 
     def with_valid(self, valid: jnp.ndarray) -> "DeviceBatch":
-        return DeviceBatch(
+        out = DeviceBatch(
             schema=self.schema,
             columns=self.columns,
             valid=valid,
             nulls=self.nulls,
             dictionaries=dict(self.dictionaries),
         )
+        # masking can only REMOVE rows, so a key-uniqueness mark (see
+        # HashAggregateExec's final-merge skip) survives it
+        if getattr(self, "keys_unique", False):
+            out.keys_unique = True
+        return out
 
     def head(self, capacity: int) -> "DeviceBatch":
         """Slice every array down to the first ``capacity`` rows (a pure
@@ -228,7 +233,16 @@ class DeviceBatch:
         padded_bytes = (padded_bytes + 1 + n_null) * self.capacity
         b = self
         if padded_bytes > self._SLICED_FETCH_BYTES:
-            n = int(fetch_arrays([self.count_valid()])[0])
+            # an operator that KNOWS a live-row ceiling host-side (e.g.
+            # GlobalLimit's fetch) saves the count sync — one fewer
+            # blocking round trip on the query's critical path. The
+            # ceiling is only trusted when it is tight enough to earn the
+            # compaction; a huge LIMIT falls back to the count sync
+            # (fetching the full padded capacity on its say-so could cost
+            # far more than the one round trip it saves).
+            n = getattr(self, "host_rows_max", None)
+            if n is None or n * 4 > self.capacity:
+                n = int(fetch_arrays([self.count_valid()])[0])
             if n * 4 <= self.capacity:
                 from ballista_tpu.ops.compact import compact
 

@@ -496,6 +496,10 @@ class HashAggregateExec(ExecutionPlan):
     # Max per-batch partial states held live before an incremental fold
     # (see _execute_partial): bounds HBM at wide cardinalities.
     _FOLD_WIDTH = 4
+    # Disjoint-path bounds are settled once per this many batches: one
+    # blocking fetch is a full host round trip (~100ms tunnelled), while
+    # the queued states bound in-flight HBM to ~a chunk of batch pipelines.
+    _SETTLE_CHUNK = 8
 
     def __init__(
         self,
@@ -790,17 +794,62 @@ class HashAggregateExec(ExecutionPlan):
 
         # Disjoint-clustered fast path: single int key and per-batch
         # state ranges that never overlap (clustered source). States are
-        # kept individually (sliced to their live prefix), the one
-        # boundary-spanning group is trimmed into the previous state, and
-        # NO fold ever runs — the final stage sees range-disjoint states
-        # and finalizes each independently. The per-state bounds fetch
-        # doubles as pipeline backpressure.
+        # kept individually (sliced to their live prefix) and NO fold ever
+        # runs — the final stage sees range-disjoint states, trims the one
+        # boundary-spanning group, and finalizes each independently.
+        # Bounds are settled in CHUNKS (one batched fetch per
+        # _SETTLE_CHUNK batches — each blocking fetch is a full host round
+        # trip on a tunnelled chip), and a short input skips the
+        # partial-side fetch entirely, deferring resolution to the final
+        # stage's own single fetch. The chunk fetch doubles as pipeline
+        # backpressure, bounding in-flight upstream work.
         disjoint = (
             n_groups == 1
             and self._schema.fields[0].dtype in _INT_KEY_DTYPES
         )
-        merge_ops_t = tuple(merge_ops)
         prev_last = None
+        entries: list = []  # queued (state, device-bounds) pairs
+
+        def settle_entries() -> None:
+            """Resolve every queued (state, bounds) pair in ONE batched
+            fetch, slicing each state to its live prefix and recording
+            host bounds for the final stage. A NULL-key group or a range
+            overlap disqualifies the disjoint layout by clearing the
+            nonlocal ``disjoint`` (the loop then reverts to the fold
+            discipline)."""
+            nonlocal prev_last, disjoint
+            from ballista_tpu.ops.fetch import fetch_arrays
+
+            if not entries:
+                return
+            raw = []
+            for _, dev, _c in entries:
+                raw.extend(dev)
+            vals = [int(v) for v in fetch_arrays(raw)]
+            ok = disjoint
+            for i, (st, _, _c) in enumerate(entries):
+                first, last, n, has_null = vals[4 * i : 4 * i + 4]
+                if n == 0:
+                    continue
+                st = _slice_state(st, n)
+                if has_null or (
+                    ok and prev_last is not None and first < prev_last
+                ):
+                    # a NULL-key group rides with key 0 + a null mask (its
+                    # bounds alias a real key-0 group); a backward first
+                    # key means the source is not clustered
+                    self.metrics.add("disjoint_break")
+                    ok = False
+                elif ok:
+                    # exactly-touching ranges (first == prev_last) stay on
+                    # the disjoint path: the final stage trims the shared
+                    # boundary group the same way it does across upstream
+                    # partitions
+                    st.host_bounds = (first, last, n, 0)
+                    prev_last = last
+                partials.append(st)
+            entries.clear()
+            disjoint = ok
 
         # Fold incrementally (the general path): a wide-cardinality
         # aggregate's per-batch states are capacity-sized device arrays,
@@ -809,46 +858,6 @@ class HashAggregateExec(ExecutionPlan):
         # 16GB chip). Folding every few batches bounds live states to
         # _FOLD_WIDTH at the cost of re-merging already-folded groups
         # (merge ops are associative).
-        def settle(entry) -> bool:
-            """Resolve one queued (state, device-bounds) pair and fold it
-            into ``partials`` under the disjoint rules. Returns False on
-            a range overlap (caller reverts to the fold discipline)."""
-            nonlocal prev_last
-            from ballista_tpu.ops.fetch import fetch_arrays
-
-            st, dev = entry
-            first, last, n, has_null = (
-                int(v) for v in fetch_arrays(list(dev))
-            )
-            if n == 0:
-                return True
-            st = _slice_state(st, n)
-            if has_null:
-                # a NULL-key group rides with key 0 + a null mask; its
-                # bounds alias a real key-0 group — disqualify the batch
-                self.metrics.add("disjoint_break")
-                partials.append(st)
-                return False
-            if prev_last is None or first > prev_last:
-                partials.append(st)
-                prev_last = last
-            elif first == prev_last and partials:
-                pm, st2 = _merge_boundary(
-                    partials[-1], st, merge_ops_t, first
-                )
-                partials[-1] = pm
-                if n > 1:
-                    partials.append(st2)
-                    prev_last = last
-                self.metrics.add("boundary_trims")
-            else:
-                # ranges overlap: not clustered
-                self.metrics.add("disjoint_break")
-                partials.append(st)
-                return False
-            return True
-
-        pending = None  # lag-1 bounds resolution: overlap the round trip
         for b in pre.execute(partition, ctx):
             with self.metrics.time("agg_time"):
                 # per-batch states come out at min(cap, batch capacity)
@@ -860,22 +869,15 @@ class HashAggregateExec(ExecutionPlan):
                 )
                 if disjoint:
                     dev = _state_bounds_dev(st)
+                    copied = True
                     for a in dev:
                         try:
                             a.copy_to_host_async()
                         except Exception:
-                            pass
-                    # settle the PREVIOUS batch's bounds while this
-                    # batch's pipeline is still in flight — the blocking
-                    # fetch doubles as pipeline backpressure
-                    if pending is not None and not settle(pending):
-                        disjoint = False
-                    pending = (st, dev)
-                    if not disjoint:
-                        # overlap detected: drain the queued entry and
-                        # revert to the fold discipline
-                        settle(pending)
-                        pending = None
+                            copied = False
+                    entries.append((st, dev, copied))
+                    if len(entries) >= self._SETTLE_CHUNK:
+                        settle_entries()
                 else:
                     partials.append(st)
                 if not disjoint and len(partials) >= self._FOLD_WIDTH:
@@ -903,24 +905,51 @@ class HashAggregateExec(ExecutionPlan):
                         _np.asarray(bp_prev)
                     bp_prev = flag
             self.metrics.add("input_batches")
-        if pending is not None:
+        if entries:
             with self.metrics.time("agg_time"):
-                settle(pending)
+                if not partials:
+                    # Short input (every batch still queued): skip the
+                    # partial-side bounds fetch entirely. States are
+                    # sliced via the learned-capacity speculation (zero
+                    # sync) and carry their pre-copied device bounds, so
+                    # the final stage resolves disjointness in its OWN
+                    # single batched fetch — or, for a lone state, not at
+                    # all.
+                    sts = [st for st, _, _c in entries]
+                    for s2, (_, dev, copied) in zip(
+                        self._slice_states(sts, ctx, site, partition),
+                        entries,
+                    ):
+                        if copied:
+                            # final resolves these host-side, no fetch
+                            s2.dev_bounds = dev
+                        partials.append(s2)
+                    entries.clear()
+                else:
+                    settle_entries()
         if not partials:
             return
+        # every state this partial emits is key-unique on its own (a
+        # per-batch grouping or a fold, both of which dedup) — mark them
+        # so the final stage's merge-skip and disjoint paths can trust
+        # uniqueness (a reader-concatenated batch carries no mark)
         if len(partials) == 1:
+            partials[0].keys_unique = True
             yield partials[0]
             return
         if disjoint:
-            # range-disjoint states: nothing shares a key, no fold needed
-            # (the final stage re-checks disjointness before skipping its
-            # merge, so this emission is safe under any consumer)
+            # range-disjoint states: the final stage resolves bounds and
+            # trims any boundary-spanning group before finalizing
+            for st in partials:
+                st.keys_unique = True
             yield from partials
             return
         # final fold of this partition's remaining states (bounds shuffle
         # volume: one folded state leaves the partition)
         with self.metrics.time("agg_time"):
-            yield fold(partials)
+            out = fold(partials)
+            out.keys_unique = True
+            yield out
 
     def _scalar_state_fn(self):
         """Jitted per-batch scalar state (one program instead of eager
@@ -999,16 +1028,16 @@ class HashAggregateExec(ExecutionPlan):
             with self.metrics.time("merge_time"):
                 yield self._scalar_final_jit(states)
             return
-        if len(states) == 1:
-            # A single state batch comes from ONE partial output (partials
-            # emit one folded state per partition; the in-proc repartition
-            # masks rather than concatenates), so its group keys are
-            # already unique — the merge aggregation would re-sort the full
-            # state capacity to rediscover the same groups. Skip it.
-            # INVARIANT: any producer that starts emitting concatenated
-            # UN-folded states (today none do — partials fold per
-            # partition, shuffle reads that split a file yield >1 batch)
-            # must also stop this skip, or duplicate groups pass through.
+        if len(states) == 1 and getattr(states[0], "keys_unique", False):
+            # The partial marks every state IT emits as key-unique (each is
+            # one per-batch grouping or a fold — both dedup), and masking
+            # repartitions preserve the mark. A lone marked state needs no
+            # merge — the merge aggregation would re-sort the full state
+            # capacity only to rediscover the same groups. A lone UNMARKED
+            # state (e.g. a shuffle reader that concatenated several
+            # partial states into one batch — those can share boundary
+            # keys, or overlap entirely for short unclustered inputs)
+            # falls through to the general merge below.
             # (Timed under merge_time so per-query metric reports stay
             # comparable with the merging shape.)
             with self.metrics.time("merge_time"):
@@ -1018,6 +1047,11 @@ class HashAggregateExec(ExecutionPlan):
         if (
             n_groups == 1
             and self._schema.fields[0].dtype in _INT_KEY_DTYPES
+            # the range-disjoint argument needs keys unique WITHIN each
+            # state too — an unmarked state (reader-concatenated partials)
+            # can carry internal duplicates that cross-state bounds
+            # cannot see
+            and all(getattr(st, "keys_unique", False) for st in states)
         ):
             # Range-disjoint states (the clustered partial emission, or
             # any shuffle layout that happens to partition cleanly):
@@ -1028,19 +1062,39 @@ class HashAggregateExec(ExecutionPlan):
             # correctness assumption.
             from ballista_tpu.ops.fetch import fetch_arrays
 
-            raw = []
-            for st in states:
-                raw.extend(_state_bounds_dev(st))
-            vals = [int(v) for v in fetch_arrays(raw)]
-            bounds = [
-                (vals[4 * i], vals[4 * i + 1], vals[4 * i + 2],
-                 vals[4 * i + 3])
-                for i in range(len(states))
+            # the partial attaches host-resolved bounds (settled chunks)
+            # or pre-copied device bounds (short inputs); only states
+            # carrying neither — e.g. arriving through a shuffle — need
+            # fresh device reductions. ONE batched fetch covers whatever
+            # is unresolved.
+            import numpy as np
+
+            bounds: list = [
+                getattr(st, "host_bounds", None) for st in states
             ]
+            raw, missing = [], []
+            for i, (st, hb) in enumerate(zip(states, bounds)):
+                if hb is None:
+                    dev = getattr(st, "dev_bounds", None)
+                    if dev is not None:
+                        # host copy already in flight since the partial
+                        # queued it — resolving here costs no round trip
+                        bounds[i] = tuple(int(np.asarray(v)) for v in dev)
+                    else:
+                        missing.append(i)
+                        raw.extend(_state_bounds_dev(st))
+            if raw:
+                vals = [int(v) for v in fetch_arrays(raw)]
+                for j, i in enumerate(missing):
+                    bounds[i] = tuple(vals[4 * j : 4 * j + 4])
             live = sorted(
                 (b for b in zip(bounds, states) if b[0][2] > 0),
                 key=lambda p: p[0][0],
             )
+            if not live:
+                # every state is empty (short inputs now defer emptiness
+                # detection here): nothing to finalize
+                return
             # exactly-touching ranges (a group split across two upstream
             # partitions) are trimmed here the same way the partial trims
             # its batch boundaries; only a real overlap — or any state

@@ -66,11 +66,38 @@ class TaskContext:
     # speculate against a value a smaller earlier batch just wrote)
     run_state: dict = dataclasses.field(default_factory=dict)
 
+    def _start_async_copy(self, *values) -> None:
+        """Start a device->host copy of each scalar NOW so raise_deferred's
+        resolution overlaps the run's final result fetch instead of paying
+        its own ~100ms tunnel round trip. Best-effort: a platform without
+        async copies falls back to the batched fetch."""
+        if self.run_state.get("_async_copy_bad"):
+            return
+        for v in values:
+            if v is None or isinstance(v, (bool, int, float)):
+                continue  # host-native: nothing to copy
+            try:
+                copy = getattr(v, "copy_to_host_async", None)
+                if copy is not None:
+                    copy()
+                elif hasattr(v, "__array__") and type(v).__module__ not in (
+                    "numpy",
+                ):
+                    # a device array WITHOUT async copies: per-value
+                    # resolution would pay one round trip each — keep the
+                    # batched fetch path instead
+                    self.run_state["_async_copy_bad"] = True
+                    return
+            except Exception:
+                self.run_state["_async_copy_bad"] = True
+                return
+
     def defer_check(self, flag, message: str, required=None) -> None:
         """Queue a device bool ``flag``; if it fires at the task boundary the
         task fails with ``message``. ``required`` (device int scalar) is the
         capacity that would have sufficed — carried on the raised
         CapacityError so the driver can retry adaptively."""
+        self._start_async_copy(flag, required)
         self.deferred_checks.append((flag, message, required))
 
     def defer_speculation(self, flag, message: str, cache_keys: list) -> None:
@@ -78,6 +105,7 @@ class TaskContext:
         fires, the task raises SpeculationMiss carrying ``cache_keys`` so
         the driver can invalidate and re-run. Rides the same single batched
         fetch as defer_check — zero extra round trips."""
+        self._start_async_copy(flag)
         self.speculative_checks.append((flag, message, list(cache_keys)))
 
     def defer_learn(self, cache_key, value) -> None:
@@ -87,6 +115,7 @@ class TaskContext:
         max-ed for ints across the run's batches; nothing is written if
         the run fails its checks."""
         if self.plan_cache is not None:
+            self._start_async_copy(value)
             self.learned_values.append((cache_key, value))
 
     def raise_deferred(self) -> None:
@@ -107,15 +136,25 @@ class TaskContext:
 
         n = len(self.deferred_checks)
         ns = len(self.speculative_checks)
-        fetched = fetch_arrays(
-            [jnp.asarray(f) for f, _, _ in self.deferred_checks]
-            + [
-                jnp.asarray(r if r is not None else 0)
-                for _, _, r in self.deferred_checks
-            ]
-            + [jnp.asarray(f) for f, _, _ in self.speculative_checks]
-            + [jnp.asarray(v) for _, v in self.learned_values]
+        # keep host-native values (python ints/bools) OUT of the device
+        # path: wrapping them in jnp.asarray would mint fresh device
+        # scalars whose resolution costs a round trip each
+        queued = (
+            [f for f, _, _ in self.deferred_checks]
+            + [r if r is not None else 0 for _, _, r in self.deferred_checks]
+            + [f for f, _, _ in self.speculative_checks]
+            + [v for _, v in self.learned_values]
         )
+        if not self.run_state.get("_async_copy_bad"):
+            # every queued device scalar started its host copy at queue
+            # time (_start_async_copy) and the run's result fetch has
+            # since drained the device queue, so these resolve without a
+            # fresh round trip each
+            import numpy as _np
+
+            fetched = [_np.asarray(v) for v in queued]
+        else:
+            fetched = fetch_arrays([jnp.asarray(v) for v in queued])
         flags, reqs = fetched[:n], fetched[n : 2 * n]
         spec_flags = fetched[2 * n : 2 * n + ns]
         learned = fetched[2 * n + ns :]

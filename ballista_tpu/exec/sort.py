@@ -150,7 +150,12 @@ class GlobalLimitExec(ExecutionPlan):
         if second is None:
             # single-batch stream (the common shape under a coalesce/sort):
             # pure device masking, no host sync
-            yield mask(first, self.skip, self.fetch)
+            out = mask(first, self.skip, self.fetch)
+            if self.fetch is not None:
+                # host-known live-row ceiling: to_host can skip its
+                # count sync and fetch a tight slice directly
+                out.host_rows_max = self.fetch
+            yield out
             return
         remaining_skip = self.skip
         remaining = self.fetch

@@ -65,19 +65,19 @@ def test_clustered_groupby_streams_disjoint_states():
     phys = ctx.create_physical_plan(ctx.sql_to_logical(SQL))
     got = ctx.sql(SQL).collect().to_pandas()
     _check(got, _oracle(t.to_pandas()))
-    def find(p):
-        for c in [p] + list(p.children()):
-            if "partial" in c.describe() and c is not p:
-                return c
-            got_ = find(c) if c is not p else None
-            if got_ is not None:
-                return got_
-        return None
-    partial = find(phys)
-    assert partial is not None
-    assert partial.metrics.counters.get("boundary_trims", 0) > 0, (
-        partial.metrics.counters
-    )
+    # boundary-spanning groups are trimmed where the bounds resolve: the
+    # final stage (chunk-settled partials hand it host bounds; short
+    # inputs hand it device bounds). Assert the trim happened SOMEWHERE
+    # in the plan and that the partial streamed without a fold.
+    counters: dict = {}
+    def walk(p):
+        for k, v in p.metrics.counters.items():
+            counters[k] = counters.get(k, 0) + v
+        for c in p.children():
+            walk(c)
+    walk(phys)
+    assert counters.get("boundary_trims", 0) > 0, counters
+    assert counters.get("disjoint_break", 0) == 0, counters
 
 
 def test_unclustered_groupby_falls_back_and_matches():
