@@ -29,11 +29,29 @@ def make_ticket(loc: PartitionLocation) -> paflight.Ticket:
 
 
 def fetch_partition(loc: PartitionLocation) -> pa.Table:
-    """ref client.rs fetch_partition (:75-130)."""
+    """ref client.rs fetch_partition (:75-130). Materializes the whole
+    partition — use for RESULT fetches; shuffle readers should stream via
+    fetch_partition_batches."""
+    try:
+        client = paflight.connect(f"grpc://{loc.host}:{loc.port}")
+        return client.do_get(make_ticket(loc)).read_all()
+    except paflight.FlightError as e:
+        raise GrpcError(
+            f"failed to fetch partition {loc.job_id}/{loc.stage_id}/"
+            f"{loc.partition} from {loc.host}:{loc.port}: {e}"
+        ) from e
+
+
+def fetch_partition_batches(loc: PartitionLocation):
+    """Stream a remote shuffle partition batch-at-a-time (the server side
+    is a GeneratorStream over the IPC file) — peak memory is one record
+    batch, not the partition."""
     try:
         client = paflight.connect(f"grpc://{loc.host}:{loc.port}")
         reader = client.do_get(make_ticket(loc))
-        return reader.read_all()
+        for chunk in reader:
+            if chunk.data is not None:
+                yield chunk.data
     except paflight.FlightError as e:
         raise GrpcError(
             f"failed to fetch partition {loc.job_id}/{loc.stage_id}/"

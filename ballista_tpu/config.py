@@ -38,6 +38,7 @@ BALLISTA_AGG_CAPACITY = "ballista.tpu.agg_capacity"  # max distinct groups per k
 BALLISTA_TPU_BATCH_ROWS = "ballista.tpu.batch_rows"  # device-batch row budget
 BALLISTA_PROFILE_DIR = "ballista.tpu.profile_dir"  # XLA profiler trace output
 BALLISTA_JOIN_EXPANSION = "ballista.tpu.join_expansion"  # probe-output expansion factor
+BALLISTA_BUILD_CACHE_MB = "ballista.tpu.build_cache_mb"  # join build-table HBM cache
 BALLISTA_COLLECTIVE_SHUFFLE = "ballista.tpu.collective_shuffle"  # on-pod all_to_all
 
 
@@ -130,6 +131,15 @@ def _entries() -> dict[str, ConfigEntry]:
             BALLISTA_AGG_CAPACITY,
             "Static capacity (max distinct groups) of device hash aggregates",
             str(1 << 16),
+            int,
+        ),
+        ConfigEntry(
+            BALLISTA_BUILD_CACHE_MB,
+            "HBM budget (MB) for caching join build tables across queries "
+            "on the same registered data. A warm TPC-H suite re-collects "
+            "and re-sorts each dimension/build side every run otherwise "
+            "(~170ms per 1.5M-row build on a v5e). 0 disables.",
+            "2048",
             int,
         ),
         ConfigEntry(
@@ -244,6 +254,9 @@ class BallistaConfig:
 
     def join_expansion(self) -> int:
         return self._get(BALLISTA_JOIN_EXPANSION)
+
+    def build_cache_mb(self) -> int:
+        return self._get(BALLISTA_BUILD_CACHE_MB)
 
     def collective_shuffle(self) -> bool:
         return self._get(BALLISTA_COLLECTIVE_SHUFFLE)

@@ -226,6 +226,72 @@ _INT_KEY_DTYPES = (
     DataType.INT32, DataType.INT64, DataType.DATE32, DataType.TIMESTAMP_US,
 )
 
+# -- exact decimal summation (see HashAggregateExec._dec_scaled_sums) --------
+# Integrality tolerance: a true decimal's f64 representation deviates from
+# integral (at its scale) by <= |v|*10^k*2^-52 ~ 1e-5 for TPC-H magnitudes;
+# arbitrary floats deviate ~uniformly up to 0.5.
+_DEC_TOL = 1e-3
+# Magnitude bound: scaled |values| must SUM below f64's exact-integer range
+# (with margin) so every reduction order yields the same exact integer.
+_DEC_BOUND = float(1 << 52)
+
+
+@functools.lru_cache(maxsize=None)
+def _dec_learn_program(cap: int, has_null: bool):
+    """Smallest scale k in {2,4,6} at which every live value is integral
+    and the worst-case sum stays exactly representable; 99 = not decimal.
+    int32 so defer_learn's cross-batch MAX picks a scale covering every
+    batch (any 99 vetoes)."""
+
+    def f(col, valid, null):
+        live = valid & ~null if has_null else valid
+        code = jnp.int32(99)
+        for k in (6, 4, 2):  # evaluate big->small so `code` ends smallest
+            s = col * float(10 ** k)
+            r = jnp.round(s)
+            dev = jnp.max(jnp.where(live, jnp.abs(s - r), 0.0))
+            total = jnp.sum(jnp.where(live, jnp.abs(r), 0.0))
+            ok = (dev <= _DEC_TOL) & (total < _DEC_BOUND)
+            code = jnp.where(ok, jnp.int32(k), code)
+        return code
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def _dec_scale_program(cap: int, has_null: bool, k: int):
+    """(col, valid, null) -> (scaled INT64 column, validation ok).
+
+    int64, not integral f64: the TPU's f64 matmul-prefix and the Pallas
+    dense kernel accumulate through f32 splits (correctly rounded but not
+    exact), while the x64 rewrite's int64 arithmetic is exact integer
+    math on every backend — the sums come out bit-identical CPU vs TPU."""
+
+    def f(col, valid, null):
+        live = valid & ~null if has_null else valid
+        s = col * float(10 ** k)
+        r = jnp.round(s)
+        dev = jnp.max(jnp.where(live, jnp.abs(s - r), 0.0))
+        total = jnp.sum(jnp.where(live, jnp.abs(r), 0.0))
+        ok = (dev <= _DEC_TOL) & (total < _DEC_BOUND)
+        return jnp.where(live, r, 0.0).astype(jnp.int64), ok
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def _dec_unscale_program(sig: tuple):
+    """Divide the scaled sum columns back to value units. sig: tuple of
+    (col index, scale) pairs — one fused program per layout."""
+
+    def f(cols):
+        cols = list(cols)
+        for i, scale in sig:
+            cols[i] = cols[i] / scale
+        return tuple(cols)
+
+    return jax.jit(f)
+
 
 @functools.lru_cache(maxsize=None)
 def _bounds_program(cap: int, dtype: str, has_null_mask: bool):
@@ -601,6 +667,71 @@ class HashAggregateExec(ExecutionPlan):
             return max(ctx.agg_capacity_override, self.capacity or 0)
         return self.capacity or ctx.config.agg_capacity()
 
+    def _dec_scaled_sums(
+        self, val_cols, val_nulls, ops, batch, ctx, site, from_state
+    ):
+        """Exact decimal summation: float64 SUM inputs that are decimals
+        (TPC-H money/quantity — every value integral at 10^k, k<=6) are
+        rounded to INTEGRAL f64 at scale 10^k before the kernel and the
+        resulting sums divided back after. Integral-f64 reductions below
+        2^52 are exact in ANY order — money sums become order-independent
+        and bit-identical across batches, tiers, and backends (CPU vs
+        TPU), which float SUM's reduction-order sensitivity breaks
+        (VERDICT r4 item 4; ref Decimal128 datafusion.proto:411-420 —
+        carried exactly through DataFusion's aggregate kernels).
+
+        k is LEARNED per (site, slot) on the first run (smallest of
+        2/4/6 whose integrality and 2^52 magnitude bound hold, 99 = not
+        decimal) through the plan cache, and every scaled run re-validates
+        on device via a deferred flag — stale data falls back through
+        SpeculationMiss like every other learned fast path. Returns
+        (val_cols, unscale list aligned with slots)."""
+        unscale = [None] * len(val_cols)
+        cache = ctx.plan_cache if ctx is not None else None
+        if cache is None or site is None:
+            return val_cols, unscale
+        job = getattr(ctx, "job_id", "")
+        out = list(val_cols)
+        for j, (vc, vn, op) in enumerate(zip(val_cols, val_nulls, ops)):
+            if op != AggOp.SUM or vc.dtype != jnp.float64:
+                continue
+            # merge sites ("dec_sum_last") REPLACE their learned scale
+            # each run instead of max-vetoing: their run-1 inputs are
+            # inexact plain-float partial sums and only become integral
+            # once the partial pass itself runs scaled (run 2+)
+            key = (
+                ("dec_sum_last" if from_state else "dec_sum"),
+                job, site, j,
+            )
+            code = cache.get(key)
+            live_args = (
+                batch.valid,
+                vn if vn is not None else batch.valid,
+                vn is not None,
+            )
+            if code is None or (from_state and code not in (2, 4, 6)):
+                ctx.defer_learn(
+                    key,
+                    _dec_learn_program(vc.shape[0], live_args[2])(
+                        vc, live_args[0], live_args[1]
+                    ),
+                )
+                continue
+            if code not in (2, 4, 6):
+                continue
+            scaled, ok = _dec_scale_program(
+                vc.shape[0], live_args[2], int(code)
+            )(vc, live_args[0], live_args[1])
+            ctx.defer_speculation(
+                ~ok,
+                "decimal-sum scaling went stale (values no longer "
+                "integral at the learned scale, or sum bound exceeded)",
+                [key],
+            )
+            out[j] = scaled
+            unscale[j] = float(10 ** int(code))
+        return out, unscale
+
     def _run_group_agg(
         self,
         batch: DeviceBatch,
@@ -640,6 +771,17 @@ class HashAggregateExec(ExecutionPlan):
         # dictionary-coded / boolean keys with a small domain take the dense
         # (sort-free, one-fused-scatter) kernel — the q1 shape
         vocab = self._dense_vocab(batch, n_groups)
+        # exact decimal summation (sort path only): money/quantity columns
+        # sum as scaled int64 (order-independent, bit-exact across tiers);
+        # sums divide back below. The dense kernel keeps f64 — int64 values
+        # would force it onto the serialized scatter path, and its f32-split
+        # matmul is deliberately approximate (~1e-8, ops/pallas_agg.py).
+        if vocab is None:
+            val_cols, dec_unscale = self._dec_scaled_sums(
+                val_cols, val_nulls, ops, batch, ctx, site, from_state
+            )
+        else:
+            dec_unscale = [None] * len(val_cols)
         if vocab is not None:
             res = dense_group_aggregate(
                 key_cols, key_nulls, vocab, batch.valid, val_cols,
@@ -695,6 +837,19 @@ class HashAggregateExec(ExecutionPlan):
         state_schema = batch.schema if from_state else self._schema
         dtypes = tuple(f.dtype.value for f in state_schema)
         out = _state_batch_program(dtypes)(res, state_schema)
+        if any(s is not None for s in dec_unscale):
+            sig = tuple(
+                (n_groups + j, s)
+                for j, s in enumerate(dec_unscale)
+                if s is not None
+            )
+            out = DeviceBatch(
+                schema=out.schema,
+                columns=_dec_unscale_program(sig)(out.columns),
+                valid=out.valid,
+                nulls=out.nulls,
+                dictionaries=dict(out.dictionaries),
+            )
         dicts = {
             k: v
             for k, v in batch.dictionaries.items()

@@ -61,10 +61,17 @@ class TaskContext:
     # (cache_key, device scalar) pairs written to plan_cache at a CLEAN
     # task boundary (see defer_learn)
     learned_values: list = dataclasses.field(default_factory=list)
+    # callables run at a CLEAN task boundary only (see defer_commit)
+    clean_commits: list = dataclasses.field(default_factory=list)
     # per-run scratch (e.g. which cache keys THIS run has already synced:
     # later batches of the same run must keep syncing/maxing, not
     # speculate against a value a smaller earlier batch just wrote)
     run_state: dict = dataclasses.field(default_factory=dict)
+    # Join build-table caching lives on PLAN INSTANCES; callers whose
+    # instances are per-task throwaways (the distributed executor decodes
+    # a fresh plan per task) must turn it off, or the shared HBM tally
+    # counts entries that die with the task and admission starves.
+    cache_builds: bool = True
 
     def _start_async_copy(self, *values) -> None:
         """Start a device->host copy of each scalar NOW so raise_deferred's
@@ -118,11 +125,21 @@ class TaskContext:
             self._start_async_copy(value)
             self.learned_values.append((cache_key, value))
 
+    def defer_commit(self, fn) -> None:
+        """Queue a host-side cache mutation to run ONLY if this task ends
+        clean. A run that fails a deferred check (capacity overflow,
+        speculation miss) may have computed results from truncated
+        intermediates — committing caches mid-run would poison retries
+        with data the failed attempt produced (observed: a SEMI build
+        table cached from an overflowed HAVING subquery)."""
+        self.clean_commits.append(fn)
+
     def raise_deferred(self) -> None:
         if (
             not self.deferred_checks
             and not self.speculative_checks
             and not self.learned_values
+            and not self.clean_commits
         ):
             return
         from ballista_tpu.errors import (
@@ -161,9 +178,11 @@ class TaskContext:
         checks = self.deferred_checks
         spec_checks = self.speculative_checks
         learn_entries = self.learned_values
+        commits = self.clean_commits
         self.deferred_checks = []
         self.speculative_checks = []
         self.learned_values = []
+        self.clean_commits = []
         # speculation misses first: the run's output is invalid regardless
         # of what the hard checks say (a stale strategy can mask them)
         spec_fired = [
@@ -183,6 +202,8 @@ class TaskContext:
             if bool(f)
         ]
         if not fired:
+            for fn in commits:
+                fn()
             # clean run: commit learned plan-shape facts (AND for bools so
             # one unsorted batch at a site vetoes the clustered fast path;
             # max for ints so capacities cover every batch)
@@ -197,9 +218,21 @@ class TaskContext:
                         )
                     else:
                         v = int(v)
-                        self.plan_cache[key] = (
-                            v if prev is None else max(prev, v)
-                        )
+                        if (
+                            isinstance(key, tuple)
+                            and key
+                            and key[0] == "dec_sum_last"
+                        ):
+                            # merge-site decimal scales REPLACE rather than
+                            # max: the first run's merge inputs are inexact
+                            # (plain-float partials) and would otherwise
+                            # veto forever; each run re-learns from its own
+                            # inputs until they are exact
+                            self.plan_cache[key] = v
+                        else:
+                            self.plan_cache[key] = (
+                                v if prev is None else max(prev, v)
+                            )
             return
         msg = "; ".join(dict.fromkeys(m for m, _ in fired))
         required = max((r for _, r in fired), default=0)
@@ -286,6 +319,7 @@ def run_with_capacity_retry(
             # a cached plan-shape guess went stale: invalidate + re-run
             ctx.deferred_checks.clear()
             ctx.speculative_checks.clear()
+            ctx.clean_commits.clear()
             if plan_cache is not None:
                 for k in e.invalid_keys:
                     plan_cache.pop(k, None)
@@ -296,6 +330,7 @@ def run_with_capacity_retry(
         except CapacityError as e:
             ctx.deferred_checks.clear()
             ctx.speculative_checks.clear()
+            ctx.clean_commits.clear()
             base = override or config.agg_capacity()
             need = max(e.required + 1, base * 2)
             new_cap = 1 << (need - 1).bit_length()

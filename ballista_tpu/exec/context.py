@@ -310,6 +310,9 @@ class TpuContext(Catalog, TableProvider):
                 # programs without bound; dropping everything is fine —
                 # a re-plan costs ~ms and recompiles hit the XLA cache
                 self._physical_cache.clear()
+                # instance-held join build tables die with their plans;
+                # reset the shared HBM tally so admission doesn't starve
+                self._plan_cache.pop("__build_cache_bytes__", None)
         partitions = self.config.default_shuffle_partitions()
         phys = PhysicalPlanner(
             self, partitions, mesh_runtime=self.mesh_runtime()

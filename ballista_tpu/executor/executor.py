@@ -22,6 +22,10 @@ from ballista_tpu.errors import ExecutionError
 from ballista_tpu.exec.base import run_with_capacity_retry
 from ballista_tpu.exec.planner import TableProvider
 from ballista_tpu.executor.shuffle import ShuffleWriterExec
+from ballista_tpu.executor import (
+    effective_task_slots,
+    visible_devices,
+)
 from ballista_tpu.proto import pb
 from ballista_tpu.scheduler.rpc import scheduler_stub
 from ballista_tpu.serde import BallistaCodec
@@ -29,6 +33,7 @@ from ballista_tpu.serde import BallistaCodec
 log = logging.getLogger(__name__)
 
 POLL_INTERVAL = 0.1  # ref execution_loop.rs:110-112 (100ms idle sleep)
+
 
 
 class Executor:
@@ -81,6 +86,10 @@ class Executor:
             ),
             hint=self._capacity_hint,
             plan_cache=self._plan_cache,
+            # plan instances are decoded fresh per task: instance-held
+            # build caches would die with the task while charging the
+            # shared HBM tally (see TaskContext.cache_builds)
+            cache_builds=False,
             session_id=task.session_id,
             job_id=task.task_id.job_id,
             work_dir=self.work_dir,
@@ -133,6 +142,7 @@ class PollLoop:
         self.scheduler_addr = scheduler_addr
         self.flight_host = flight_host
         self.flight_port = flight_port
+        task_slots = effective_task_slots(task_slots)
         self.task_slots = task_slots
         self._available = threading.Semaphore(task_slots)
         self._statuses: queue.Queue = queue.Queue()
@@ -155,7 +165,9 @@ class PollLoop:
             id=self.executor.executor_id,
             host=self.flight_host,
             port=self.flight_port,
-            specification=pb.ExecutorSpecification(task_slots=self.task_slots),
+            specification=pb.ExecutorSpecification(
+                task_slots=self.task_slots, n_devices=visible_devices()
+            ),
         )
 
     def run(self) -> None:

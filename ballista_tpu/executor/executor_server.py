@@ -19,6 +19,10 @@ import traceback
 import grpc
 
 from ballista_tpu.executor.executor import Executor, as_task_status
+from ballista_tpu.executor import (
+    effective_task_slots,
+    visible_devices,
+)
 from ballista_tpu.proto import pb
 from ballista_tpu.scheduler.rpc import (
     EXECUTOR_METHODS,
@@ -40,6 +44,7 @@ HEARTBEAT_INTERVAL_S = 15.0
 RPC_TIMEOUT_S = 10.0
 
 
+
 class ExecutorServer:
     """Push-mode executor process body."""
 
@@ -56,6 +61,7 @@ class ExecutorServer:
         self.scheduler_addr = scheduler_addr
         self.flight_host = flight_host
         self.flight_port = flight_port
+        task_slots = effective_task_slots(task_slots)
         self.task_slots = task_slots
         self.heartbeat_interval_s = heartbeat_interval_s
         self._queue: queue.Queue = queue.Queue()
@@ -117,7 +123,9 @@ class ExecutorServer:
             host=self.flight_host,
             port=self.flight_port,
             grpc_port=self.grpc_port,
-            specification=pb.ExecutorSpecification(task_slots=self.task_slots),
+            specification=pb.ExecutorSpecification(
+                task_slots=self.task_slots, n_devices=visible_devices()
+            ),
         )
 
     def _heartbeat_loop(self) -> None:

@@ -31,8 +31,15 @@ class BallistaFlightService(paflight.FlightServerBase):
             )
         path = action.fetch_partition.path
         reader = paipc.open_file(path)
-        table = reader.read_all()
-        return paflight.RecordBatchStream(table)
+
+        # Stream the file batch-at-a-time (ref flight_service.rs:203-228
+        # sends batches through a channel) — read_all() here held the whole
+        # shuffle partition in server memory, an OOM at SF=100 widths.
+        def batches(r=reader):
+            for i in range(r.num_record_batches):
+                yield r.get_batch(i)
+
+        return paflight.GeneratorStream(reader.schema, batches())
 
     # Remaining verbs deliberately unimplemented (ref :119-184).
 

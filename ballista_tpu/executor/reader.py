@@ -39,6 +39,20 @@ def fetch_partition_table(loc: PartitionLocation) -> pa.Table:
     return fetch_partition(loc)
 
 
+def fetch_partition_batches(loc: PartitionLocation) -> Iterator[pa.RecordBatch]:
+    """One shuffle file -> record-batch stream; peak memory is a batch,
+    not the partition (ref shuffle_reader.rs streams batches through the
+    Flight channel; read_all here was an OOM at SF=100 shuffle widths)."""
+    if os.path.exists(loc.path):
+        with paipc.open_file(loc.path) as r:
+            for i in range(r.num_record_batches):
+                yield r.get_batch(i)
+        return
+    from ballista_tpu.client.flight import fetch_partition_batches as remote
+
+    yield from remote(loc)
+
+
 class ShuffleReaderExec(ExecutionPlan):
     def __init__(
         self,
@@ -72,17 +86,44 @@ class ShuffleReaderExec(ExecutionPlan):
             return
         any_rows = False
         batch_rows = min(BATCH_ROWS, ctx.config.tpu_batch_rows())
-        for loc in locs:
-            with self.metrics.time("fetch_time"):
-                t = fetch_partition_table(loc)
-            self.metrics.add("fetched_batches")
-            if t.num_rows == 0:
-                continue
-            any_rows = True
+        # Streamed re-chunking: record batches accumulate only up to the
+        # device-batch row budget before flushing to device, so host
+        # memory is bounded by one device batch regardless of how wide
+        # the shuffle partition is.
+        pending: list[pa.RecordBatch] = []
+        pending_rows = 0
+
+        def flush():
+            t = pa.Table.from_batches(pending)
+            pending.clear()
             # narrowing OFF: shuffle files from different writers must
             # share one physical layout (a per-file decision would flip
             # int32/int64 between files and double downstream compiles)
-            for b in table_from_arrow(t, batch_rows, frozenset()):
-                yield b
+            return table_from_arrow(t, batch_rows, frozenset())
+
+        for loc in locs:
+            it = fetch_partition_batches(loc)
+            got_any = False
+            while True:
+                # only the pull is timed: flushing to device must not be
+                # billed as fetch, and the timer must close before a yield
+                # suspends this generator
+                with self.metrics.time("fetch_time"):
+                    rb = next(it, None)
+                if rb is None:
+                    break
+                got_any = True
+                if rb.num_rows == 0:
+                    continue
+                any_rows = True
+                pending.append(rb)
+                pending_rows += rb.num_rows
+                if pending_rows >= batch_rows:
+                    yield from flush()
+                    pending_rows = 0
+            if got_any:
+                self.metrics.add("fetched_batches")
+        if pending:
+            yield from flush()
         if not any_rows:
             yield DeviceBatch.empty(self._schema)

@@ -412,8 +412,10 @@ def _compile_is_null(expr, schema: Schema):
     def fn(batch: DeviceBatch) -> ColumnValue:
         v = f(batch)
         if v.nulls is None:
+            # no null mask = nothing is null: IS NULL -> all False,
+            # IS NOT NULL -> all True
             out = jnp.full(v.values.shape, not want_null, dtype=bool)
-            return ColumnValue(out if not want_null else ~out, None, DataType.BOOL)
+            return ColumnValue(out, None, DataType.BOOL)
         vals = v.nulls if want_null else ~v.nulls
         return ColumnValue(vals, None, DataType.BOOL)
 

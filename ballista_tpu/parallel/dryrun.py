@@ -75,6 +75,55 @@ def run(n_devices: int) -> None:
     )
     np.testing.assert_array_equal(out.c.to_numpy(), want["count"].to_numpy())
 
+    # SCHEDULER PATH (SURVEY build-order #6): the same query through the
+    # full distributed control plane — the executor registers n_devices,
+    # the scheduler plans a fused mesh stage-chain, the stage plan crosses
+    # the serde boundary, and the executor runs it via its own
+    # MeshRuntime. Asserts mesh placement in the EXECUTOR-side stage plan
+    # and the same oracle values end-to-end over gRPC/Flight.
+    import time
+
+    from ballista_tpu.client.context import BallistaContext
+
+    dctx = BallistaContext.standalone()
+    try:
+        sched = dctx._standalone_cluster.scheduler
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            specs = [
+                em.specification
+                for em in sched.executor_manager.all_executors()
+            ]
+            if any((s.n_devices or 1) >= n_devices for s in specs):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                f"executor never advertised {n_devices} devices: {specs}"
+            )
+        dctx.register_table("fact", fact)
+        dctx.register_table("dim", dim)
+        dout = dctx.sql(sql).collect().to_pandas()
+        stage_disp = "\n".join(
+            stage.plan.display()
+            for job in sched.jobs.values()
+            for stage in job.stages.values()
+        )
+        assert "MeshJoinExec" in stage_disp and (
+            "MeshAggregateExec" in stage_disp
+        ), f"mesh ops missing from distributed stage plans:\n{stage_disp}"
+        np.testing.assert_array_equal(
+            dout.grp.to_numpy(), want.grp.to_numpy()
+        )
+        np.testing.assert_allclose(
+            dout.s.to_numpy(), want["sum"].to_numpy(), rtol=1e-9
+        )
+        np.testing.assert_array_equal(
+            dout.c.to_numpy(), want["count"].to_numpy()
+        )
+    finally:
+        dctx._standalone_cluster.stop()
+
 
 def main() -> None:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 8

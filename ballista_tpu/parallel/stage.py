@@ -26,11 +26,23 @@ on the mesh.
 from __future__ import annotations
 
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
+
+# Collective programs from CONCURRENT host threads (a multi-slot executor
+# running two mesh stage-tasks at once) can interleave their per-device
+# executions — device 0 enters program A's all_to_all while device 1 is in
+# program B's, and the rendezvous deadlocks (observed on the virtual CPU
+# mesh: "Expected 8 threads to join... not all arrived"). One program's
+# collectives must fully complete before another dispatches, so every
+# runner method holds this process-global lock through dispatch AND a
+# completion barrier.
+_COLLECTIVE_LOCK = threading.Lock()
 
 from ballista_tpu.columnar.batch import DeviceBatch, round_capacity
 from ballista_tpu.datatypes import DataType, Field, Schema
@@ -99,12 +111,15 @@ class MeshStageRunner:
                 batch, tuple(key_idxs), tuple(val_idxs), tuple(ops),
                 capacity, bcap,
             )
-            out_cols, out_nulls, out_valid, grp_ovf, need = prog(
-                batch.columns, batch.nulls, batch.valid
-            )
-            from ballista_tpu.ops.fetch import fetch_arrays
+            with _COLLECTIVE_LOCK:
+                out_cols, out_nulls, out_valid, grp_ovf, need = prog(
+                    batch.columns, batch.nulls, batch.valid
+                )
+                from ballista_tpu.ops.fetch import fetch_arrays
 
-            grp_ovf, need = fetch_arrays([grp_ovf, need])
+                # the fetch doubles as the completion barrier the lock needs
+                grp_ovf, need = fetch_arrays([grp_ovf, need])
+                jax.block_until_ready(out_valid)
             if not np.any(grp_ovf):
                 break
             required = int(np.max(need))
@@ -251,9 +266,11 @@ class MeshStageRunner:
             (kk.col, kk.ascending, kk.nulls_first) for kk in keys
         )
         prog = self._topk_program(batch, key_sig, k)
-        out_cols, out_nulls, out_valid = prog(
-            batch.columns, batch.nulls, batch.valid
-        )
+        with _COLLECTIVE_LOCK:
+            out_cols, out_nulls, out_valid = prog(
+                batch.columns, batch.nulls, batch.valid
+            )
+            jax.block_until_ready(out_valid)
         return DeviceBatch(
             schema=batch.schema,
             columns=tuple(out_cols),
@@ -382,15 +399,20 @@ class MeshStageRunner:
                 left, right, tuple(left_keys), tuple(right_keys),
                 join_type, bcap, mode, ocap, filter_fn,
             )
-            cols, nulls, valid, bucket_ovf, run_ovf, exp_ovf, totals = prog(
-                left.columns, left.nulls, left.valid,
-                right.columns, right.nulls, right.valid,
-            )
-            from ballista_tpu.ops.fetch import fetch_arrays
+            with _COLLECTIVE_LOCK:
+                cols, nulls, valid, bucket_ovf, run_ovf, exp_ovf, totals = (
+                    prog(
+                        left.columns, left.nulls, left.valid,
+                        right.columns, right.nulls, right.valid,
+                    )
+                )
+                from ballista_tpu.ops.fetch import fetch_arrays
 
-            bucket_ovf, run_ovf, exp_ovf, totals = fetch_arrays(
-                [bucket_ovf, run_ovf, exp_ovf, totals]
-            )
+                # fetch doubles as the completion barrier the lock needs
+                bucket_ovf, run_ovf, exp_ovf, totals = fetch_arrays(
+                    [bucket_ovf, run_ovf, exp_ovf, totals]
+                )
+                jax.block_until_ready(valid)
             if np.any(run_ovf):
                 raise ExecutionError(
                     "mesh join build side has a packed-hash collision run "

@@ -56,6 +56,7 @@ class PersistentSchedulerState:
                 "port": meta.port,
                 "grpc_port": meta.grpc_port,
                 "task_slots": meta.specification.task_slots,
+                "n_devices": meta.specification.n_devices,
             }
         ).encode()
         with self.backend.lock():  # ref persistent_state.rs:313-319
@@ -74,7 +75,8 @@ class PersistentSchedulerState:
                     port=d["port"],
                     grpc_port=d.get("grpc_port", 0),
                     specification=ExecutorSpecification(
-                        task_slots=d.get("task_slots", 4)
+                        task_slots=d.get("task_slots", 4),
+                        n_devices=d.get("n_devices", 1),
                     ),
                 )
             )

@@ -62,6 +62,23 @@ def generate_job_id() -> str:
     return "".join(random.choices(string.ascii_lowercase + string.digits, k=7))
 
 
+class _MeshPlanningHandle:
+    """Stand-in MeshRuntime used ONLY during scheduler-side planning: the
+    Mesh*Exec constructors store it without touching devices, the serde
+    encoder never serializes it, and the decoding executor replaces it
+    with a real MeshRuntime over its own mesh. Executing a plan holding
+    this handle is a bug — fail loudly."""
+
+    mesh = None
+    runner = None
+
+    def place(self, *_a, **_k):  # pragma: no cover
+        raise PlanError(
+            "planning-only mesh handle executed on the scheduler; mesh "
+            "stages must run on a mesh-capable executor"
+        )
+
+
 @dataclasses.dataclass
 class JobInfo:
     job_id: str
@@ -328,8 +345,32 @@ class SchedulerServer:
             cfg.default_shuffle_partitions(),
             config=cfg,
             distributed=True,
+            mesh_runtime=self._mesh_planning_runtime(cfg),
         ).plan(optimized)
         return self.submit_physical(physical, session_id)
+
+    def _mesh_planning_runtime(self, cfg):
+        """Planning-only mesh handle: when the session keeps collective
+        shuffle on AND some alive executor advertises >= 2 devices
+        (ExecutorSpecification.n_devices), the plan lowers repartitioned
+        aggregates / partitioned joins / bounded sorts to Mesh*Exec.
+        Between shuffle boundaries those fuse a whole chain
+        (scan -> join -> aggregate) into ONE task that the mesh-capable
+        executor runs as a single shard_map program with all_to_all over
+        its device mesh — the scheduler itself never executes this handle
+        (the decoding executor binds its own MeshRuntime via serde).
+        SURVEY build-order #6: stage placement onto TPU slices."""
+        if not cfg.collective_shuffle():
+            return None
+        alive = self.executor_manager.get_alive_executors(
+            self.executor_timeout_s
+        )
+        capable = any(
+            (em.specification.n_devices or 1) >= 2
+            for em in self.executor_manager.all_executors()
+            if em.id in alive
+        )
+        return _MeshPlanningHandle() if capable else None
 
     def submit_physical(self, physical: ExecutionPlan, session_id: str) -> str:
         job_id = generate_job_id()
@@ -759,7 +800,8 @@ class SchedulerGrpcServicer:
             port=meta.port,
             grpc_port=meta.grpc_port,
             specification=ExecutorSpecification(
-                task_slots=meta.specification.task_slots or 4
+                task_slots=meta.specification.task_slots or 4,
+                n_devices=meta.specification.n_devices or 1,
             ),
         )
         self.s.executor_manager.save_executor_metadata(em)
@@ -799,7 +841,8 @@ class SchedulerGrpcServicer:
             port=meta.port,
             grpc_port=meta.grpc_port,
             specification=ExecutorSpecification(
-                task_slots=meta.specification.task_slots or 4
+                task_slots=meta.specification.task_slots or 4,
+                n_devices=meta.specification.n_devices or 1,
             ),
         )
         self.s.executor_manager.save_executor_metadata(em)

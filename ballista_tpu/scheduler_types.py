@@ -47,6 +47,9 @@ class PartitionLocation:
 @dataclasses.dataclass(frozen=True)
 class ExecutorSpecification:
     task_slots: int = 4
+    # devices visible to the executor; >= 2 advertises mesh capability
+    # (the scheduler may plan fused mesh stage-chains for it)
+    n_devices: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

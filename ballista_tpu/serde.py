@@ -598,9 +598,22 @@ class BallistaCodec:
         self,
         provider: TableProvider | None = None,
         extension: PhysicalExtensionCodec | None = None,
+        mesh_runtime=None,
     ):
         self.provider = provider
         self.extension = extension or PhysicalExtensionCodec()
+        # binds decoded Mesh*Exec nodes to THIS process's device mesh (an
+        # executor decodes a scheduler-planned mesh stage-chain against its
+        # own devices); None = build one lazily over all local devices
+        self.mesh_runtime = mesh_runtime
+
+    def _mesh_runtime(self):
+        if self.mesh_runtime is None:
+            from ballista_tpu.exec.mesh import MeshRuntime
+            from ballista_tpu.parallel import make_mesh
+
+            self.mesh_runtime = MeshRuntime(make_mesh())
+        return self.mesh_runtime
 
     # -- encode --------------------------------------------------------------
     def physical_to_proto(self, plan: ExecutionPlan) -> pb.PhysicalPlanNode:
@@ -676,6 +689,45 @@ class BallistaCodec:
                     input=self.physical_to_proto(plan.input),
                     keys=[expr_to_proto(k) for k in plan.keys],
                     partitions=plan.partitions,
+                )
+            )
+        from ballista_tpu.exec.mesh import (
+            MeshAggregateExec,
+            MeshJoinExec,
+            MeshSortExec,
+        )
+
+        if isinstance(plan, MeshAggregateExec):
+            return pb.PhysicalPlanNode(
+                mesh_aggregate=pb.PhysicalMeshAggregateNode(
+                    input=self.physical_to_proto(plan.input),
+                    group_exprs=[
+                        expr_to_proto(e) for e in plan.group_exprs
+                    ],
+                    agg_exprs=[expr_to_proto(e) for e in plan.agg_exprs],
+                )
+            )
+        if isinstance(plan, MeshJoinExec):
+            node = pb.PhysicalMeshJoinNode(
+                left=self.physical_to_proto(plan.left),
+                right=self.physical_to_proto(plan.right),
+                on=[
+                    pb.JoinOnPair(
+                        left=expr_to_proto(a), right=expr_to_proto(b)
+                    )
+                    for a, b in plan.on
+                ],
+                join_type=getattr(pb, f"JOIN_{plan.join_type.name}"),
+            )
+            if plan.filter is not None:
+                node.filter.CopyFrom(expr_to_proto(plan.filter))
+            return pb.PhysicalPlanNode(mesh_join=node)
+        if isinstance(plan, MeshSortExec):
+            return pb.PhysicalPlanNode(
+                mesh_sort=pb.PhysicalMeshSortNode(
+                    input=self.physical_to_proto(plan.input),
+                    sort_exprs=_sort_exprs_to_proto(plan.sort_exprs),
+                    fetch=plan.fetch,
                 )
             )
         if isinstance(plan, CrossJoinExec):
@@ -907,6 +959,41 @@ class BallistaCodec:
                 self.physical_from_proto(n.input),
                 [expr_from_proto(k) for k in n.keys],
                 int(n.partitions),
+            )
+        if kind == "mesh_aggregate":
+            from ballista_tpu.exec.mesh import MeshAggregateExec
+
+            n = p.mesh_aggregate
+            return MeshAggregateExec(
+                self.physical_from_proto(n.input),
+                [expr_from_proto(e) for e in n.group_exprs],
+                [expr_from_proto(e) for e in n.agg_exprs],
+                self._mesh_runtime(),
+            )
+        if kind == "mesh_join":
+            from ballista_tpu.exec.mesh import MeshJoinExec
+
+            n = p.mesh_join
+            return MeshJoinExec(
+                self.physical_from_proto(n.left),
+                self.physical_from_proto(n.right),
+                [
+                    (expr_from_proto(o.left), expr_from_proto(o.right))
+                    for o in n.on
+                ],
+                P.JoinType[pb.JoinTypeP.Name(n.join_type)[5:]],
+                expr_from_proto(n.filter) if n.HasField("filter") else None,
+                self._mesh_runtime(),
+            )
+        if kind == "mesh_sort":
+            from ballista_tpu.exec.mesh import MeshSortExec
+
+            n = p.mesh_sort
+            return MeshSortExec(
+                self.physical_from_proto(n.input),
+                _sort_exprs_from_proto(n.sort_exprs),
+                int(n.fetch),
+                self._mesh_runtime(),
             )
         if kind == "cross_join":
             return CrossJoinExec(

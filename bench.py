@@ -266,6 +266,36 @@ def main() -> None:
         )
 
     detail = {"device": device_run, "cpu": cpu_run}
+
+    # Pinned denominator: a frozen, committed CPU-baseline artifact so
+    # round-over-round ratios measure the DEVICE, not drift in a shared
+    # host's CPU timings (observed ±30% swings across rounds). Freeze the
+    # current live CPU suite with BENCH_FREEZE=1; vs_frozen is reported
+    # alongside the live ratio whenever SF + query set match.
+    frozen_path = HERE / "BENCH_BASELINE.json"
+    vs_frozen = None
+    if cpu_run is not None and os.environ.get("BENCH_FREEZE"):
+        frozen_path.write_text(
+            json.dumps(
+                {"sf": SF, "queries": sorted(QUERIES), "cpu": cpu_run},
+                indent=2,
+            )
+        )
+    if frozen_path.exists():
+        try:
+            frozen = json.loads(frozen_path.read_text())
+            if frozen.get("sf") == SF and frozen.get("queries") == sorted(
+                QUERIES
+            ):
+                ft = sum(
+                    q["warm_best_s"]
+                    for q in frozen["cpu"]["queries"].values()
+                )
+                vs_frozen = round(ft / device_run["warm_total_s"], 3)
+                detail["frozen_cpu_total_s"] = round(ft, 4)
+        except (json.JSONDecodeError, KeyError, TypeError):
+            pass
+
     (HERE / "BENCH_DETAIL.json").write_text(json.dumps(detail, indent=2))
     print(json.dumps(detail, indent=2), file=sys.stderr)
 
@@ -274,20 +304,19 @@ def main() -> None:
         # speedup on identical warm work: cpu_total / device_total
         cpu_total = sum(q["warm_best_s"] for q in cpu_run["queries"].values())
         vs = round(cpu_total / device_run["warm_total_s"], 3)
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"tpch_sf{SF}_warm_throughput_"
-                    + "_".join(QUERIES)
-                    + f"_{device_run['backend']}"
-                ),
-                "value": device_run["queries_per_s"],
-                "unit": "queries/sec",
-                "vs_baseline": vs,
-            }
-        )
-    )
+    line = {
+        "metric": (
+            f"tpch_sf{SF}_warm_throughput_"
+            + "_".join(QUERIES)
+            + f"_{device_run['backend']}"
+        ),
+        "value": device_run["queries_per_s"],
+        "unit": "queries/sec",
+        "vs_baseline": vs,
+    }
+    if vs_frozen is not None:
+        line["vs_frozen_cpu"] = vs_frozen
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,51 @@
+"""DataFrame-builder API: compose queries without SQL.
+
+The TPU-native analogue of the reference's DataFrame usage
+(BallistaContext::read_csv().filter().aggregate() chains,
+ref python/src/dataframe.rs:55-137): the same logical plans the SQL front
+end produces, built programmatically.
+
+Run:  python examples/dataframe.py
+"""
+
+import numpy as np
+import pyarrow as pa
+
+from ballista_tpu import functions as F
+from ballista_tpu.exec.context import TpuContext
+from ballista_tpu.expr.logical import col, lit
+
+
+def main() -> None:
+    ctx = TpuContext()
+    rng = np.random.default_rng(2)
+    n = 10_000
+    ctx.register_table(
+        "trips",
+        pa.table(
+            {
+                "vendor": pa.array(rng.integers(1, 4, n)),
+                "passengers": pa.array(rng.integers(1, 6, n)),
+                "fare": pa.array(np.round(rng.uniform(3, 80, n), 2)),
+            }
+        ),
+    )
+
+    df = (
+        ctx.table("trips")
+        .filter(col("passengers") > lit(1))
+        .aggregate(
+            [col("vendor")],
+            [
+                F.count_star().alias("trips"),
+                F.sum("fare").alias("revenue"),
+                F.avg("fare").alias("avg_fare"),
+            ],
+        )
+        .sort(col("vendor"))
+    )
+    df.show()
+
+
+if __name__ == "__main__":
+    main()

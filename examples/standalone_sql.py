@@ -1,0 +1,52 @@
+"""Standalone-cluster SQL: scheduler + executor in one process.
+
+The TPU-native analogue of the reference's examples/standalone-sql.rs —
+boot an in-proc cluster (real gRPC control plane + Flight data plane),
+register a CSV, run SQL, print the result.
+
+Run:  python examples/standalone_sql.py
+"""
+
+import csv
+import os
+import random
+import tempfile
+
+from ballista_tpu.client.context import BallistaContext
+from ballista_tpu.config import BallistaConfig
+
+
+def main() -> None:
+    # a small CSV on disk, like the reference's testdata file
+    tmp = tempfile.mkdtemp(prefix="ballista-example-")
+    path = os.path.join(tmp, "sales.csv")
+    rng = random.Random(0)
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["region", "amount"])
+        for _ in range(100):
+            w.writerow(
+                [rng.choice(["east", "west", "north"]),
+                 round(rng.uniform(1, 100), 2)]
+            )
+
+    config = (
+        BallistaConfig.builder()
+        .with_setting("ballista.shuffle.partitions", "1")
+    )
+    ctx = BallistaContext.standalone(config=config)
+    ctx.sql(
+        f"CREATE EXTERNAL TABLE test STORED AS CSV "
+        f"WITH HEADER ROW LOCATION '{path}'"
+    ).collect()
+
+    df = ctx.sql(
+        "SELECT region, COUNT(1) AS n, SUM(amount) AS total "
+        "FROM test GROUP BY region ORDER BY region"
+    )
+    df.show()
+    ctx.close()
+
+
+if __name__ == "__main__":
+    main()

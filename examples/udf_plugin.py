@@ -1,0 +1,64 @@
+"""UDF plugin: drop a .py file in a plugin dir, use it from SQL.
+
+The TPU-native analogue of the reference's plugin manager
+(ref core/src/plugin/mod.rs:36-127, which dlopens .so files): plugins are
+Python modules exposing ``register(register_udf)``; UDF bodies are
+jax-traceable, so they fuse into the same XLA programs as built-ins.
+
+Run:  python examples/udf_plugin.py
+"""
+
+import os
+import tempfile
+import textwrap
+
+import numpy as np
+import pyarrow as pa
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.exec.context import TpuContext
+
+
+def main() -> None:
+    plugin_dir = tempfile.mkdtemp(prefix="ballista-plugins-")
+    with open(os.path.join(plugin_dir, "my_math.py"), "w") as f:
+        f.write(
+            textwrap.dedent(
+                """
+                import jax.numpy as jnp
+                from ballista_tpu.datatypes import DataType
+
+                def register(register_udf):
+                    register_udf(
+                        "relu", lambda x: jnp.maximum(x, 0.0),
+                        DataType.FLOAT64,
+                    )
+                    register_udf(
+                        "squared_distance", lambda a, b: (a - b) * (a - b),
+                        DataType.FLOAT64, min_args=2, max_args=2,
+                    )
+                """
+            )
+        )
+
+    ctx = TpuContext(
+        BallistaConfig().with_setting("ballista.plugin_dir", plugin_dir)
+    )
+    rng = np.random.default_rng(3)
+    ctx.register_table(
+        "points",
+        pa.table(
+            {
+                "x": pa.array(rng.normal(0, 2, 1000)),
+                "y": pa.array(rng.normal(1, 2, 1000)),
+            }
+        ),
+    )
+    ctx.sql(
+        "SELECT COUNT(*) AS n, AVG(relu(x)) AS avg_relu_x, "
+        "AVG(squared_distance(x, y)) AS mean_sq_dist FROM points"
+    ).show()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Cross-run join build-table cache (exec/joins.py _build_cache).
+
+Covers the round-5 regression: a cold run whose HAVING subquery overflows
+the aggregate capacity fails its deferred check AFTER the SEMI join
+already built (and tried to cache) a table from the truncated subquery
+output. The cache must only commit at a CLEAN task boundary
+(TaskContext.defer_commit), or every retry — and every warm run — reuses
+the poisoned build.
+"""
+
+import numpy as np
+import pyarrow as pa
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.exec.context import TpuContext
+
+
+def _data(n_keys=3000, reps=5):
+    rng = np.random.default_rng(11)
+    keys = np.repeat(np.arange(1, n_keys + 1, dtype=np.int64), reps)
+    qty = rng.integers(1, 60, len(keys)).astype(np.int64)
+    fact = pa.table({"k": pa.array(keys), "q": pa.array(qty)})
+    dim = pa.table({
+        "k": pa.array(np.arange(1, n_keys + 1, dtype=np.int64)),
+        "name": pa.array([f"n{i}" for i in range(n_keys)]),
+    })
+    return fact, dim
+
+
+SQL = (
+    "SELECT d.k, SUM(f.q) AS s FROM f, d WHERE f.k = d.k AND f.k IN "
+    "(SELECT k FROM f GROUP BY k HAVING SUM(q) > 200) GROUP BY d.k"
+)
+
+
+def _oracle(fact):
+    df = fact.to_pandas()
+    sums = df.groupby("k").q.sum()
+    keep = sums[sums > 200]
+    return keep
+
+
+def test_semi_build_correct_after_capacity_retry():
+    fact, dim = _data()
+    # tiny starting capacity: the subquery's 3000 groups overflow it, so
+    # the cold run takes the CapacityError -> adaptive-retry path while
+    # the semi build table has already been computed from truncated state
+    ctx = TpuContext(
+        BallistaConfig()
+        .with_setting("ballista.shuffle.partitions", "1")
+        .with_setting("ballista.tpu.agg_capacity", "256")
+    )
+    ctx.register_table("f", fact)
+    ctx.register_table("d", dim)
+    want = _oracle(fact)
+    for attempt in range(3):  # cold (retry inside) + warm runs
+        got = ctx.sql(SQL).collect().to_pandas()
+        got.columns = ["k", "s"]
+        got = got.sort_values("k")
+        assert len(got) == len(want), (attempt, len(got), len(want))
+        np.testing.assert_array_equal(got.k.values, want.index.values)
+        np.testing.assert_array_equal(got.s.values, want.values)
+
+
+def test_build_cache_reused_across_queries():
+    fact, dim = _data()
+    ctx = TpuContext(
+        BallistaConfig().with_setting("ballista.shuffle.partitions", "1")
+    )
+    ctx.register_table("f", fact)
+    ctx.register_table("d", dim)
+    sql = "SELECT COUNT(*) AS c FROM f, d WHERE f.k = d.k"
+    first = ctx.sql(sql).collect().to_pandas().c.iloc[0]
+    phys = ctx.create_physical_plan(ctx.sql_to_logical(sql))
+    second = ctx.sql(sql).collect().to_pandas().c.iloc[0]
+    assert first == second == fact.num_rows
+    # some join node on the cached plan instance holds a build table
+    def cached_entries(p):
+        tot = len(getattr(p, "_build_cache", {}))
+        for c in p.children():
+            tot += cached_entries(c)
+        return tot
+    assert cached_entries(phys) >= 1
+    # data change invalidates: re-registering drops the plan instances
+    ctx.register_table("f", fact.slice(0, 100))
+    got = ctx.sql(sql).collect().to_pandas().c.iloc[0]
+    assert got == 100

@@ -129,7 +129,12 @@ import pyarrow as pa
 from ballista_tpu.client.context import BallistaContext
 from ballista_tpu.config import BallistaConfig
 
-cfg = BallistaConfig().with_setting("ballista.shuffle.partitions", "3")
+cfg = (BallistaConfig()
+       .with_setting("ballista.shuffle.partitions", "3")
+       # pin the multi-task file-shuffle path: with a mesh-capable
+       # executor the scheduler would otherwise fuse these stages
+       # into one mesh task (covered by test_tpch_distributed)
+       .with_setting("ballista.tpu.collective_shuffle", "false"))
 ctx = BallistaContext.standalone(cfg)
 
 n = 20000

@@ -1,0 +1,94 @@
+"""Shuffle data plane streams batch-at-a-time (VERDICT r4 item 5).
+
+The Flight server must not materialize a whole shuffle partition
+(flight_service read_all was an OOM at SF=100 widths), and the shuffle
+reader must re-chunk a batch stream without accumulating the partition.
+Peak-RSS growth while streaming a partition much larger than any single
+batch is asserted in a SUBPROCESS (VmHWM is per-process monotonic, so the
+parent's own high-water mark cannot mask the measurement).
+
+ref: flight_service.rs:203-228 (batch channel), shuffle_reader.rs:44-294.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+from tests.conftest import CPU_MESH_ENV
+
+SCRIPT = r"""
+import os, sys, tempfile
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.ipc as paipc
+
+def hwm_kb():
+    for line in open("/proc/self/status"):
+        if line.startswith("VmHWM"):
+            return int(line.split()[1])
+    raise RuntimeError("no VmHWM")
+
+# ~256MB shuffle partition in 2MB record batches
+tmp = tempfile.mkdtemp()
+path = os.path.join(tmp, "data-0.arrow")
+schema = pa.schema([("k", pa.int64()), ("v", pa.float64())])
+rows_per = 1 << 17          # 2MB per batch
+n_batches = 128             # 256MB total
+with paipc.new_file(path, schema) as w:
+    rb = pa.record_batch(
+        [pa.array(np.arange(rows_per, dtype=np.int64)),
+         pa.array(np.random.rand(rows_per))], schema=schema)
+    for _ in range(n_batches):
+        w.write_batch(rb)
+file_mb = os.path.getsize(path) / (1 << 20)
+assert file_mb > 200, file_mb
+
+from ballista_tpu.executor.flight_service import start_flight_server
+from ballista_tpu.executor.reader import ShuffleReaderExec
+from ballista_tpu.scheduler_types import PartitionLocation
+from ballista_tpu.datatypes import DataType, Field, Schema
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.exec.base import TaskContext
+
+svc, port, _t = start_flight_server("127.0.0.1", 0, tmp)
+# remote shape: a non-existent LOCAL path forces the Flight fetch; the
+# ticket is patched to carry the real served path
+remote = PartitionLocation(
+    job_id="j", stage_id=1, partition=0, executor_id="e1",
+    host="127.0.0.1", port=port, path="/nonexistent/" + os.path.basename(path),
+)
+import dataclasses
+import ballista_tpu.client.flight as fl
+orig = fl.make_ticket
+fl.make_ticket = lambda l: orig(dataclasses.replace(l, path=path))
+
+schema2 = Schema([Field("k", DataType.INT64), Field("v", DataType.FLOAT64)])
+plan = ShuffleReaderExec([[remote]], schema2)
+ctx = TaskContext(config=BallistaConfig())
+
+base = hwm_kb()
+total = 0
+for b in plan.execute(0, ctx):
+    total += int(np.asarray(b.count_valid()))
+growth_mb = (hwm_kb() - base) / 1024
+assert total == rows_per * n_batches, (total, rows_per * n_batches)
+# streaming bound: growth must stay well under the 256MB partition
+assert growth_mb < 140, f"peak RSS grew {growth_mb:.0f}MB for a {file_mb:.0f}MB partition"
+print(f"STREAM-OK total={total} growth={growth_mb:.0f}MB file={file_mb:.0f}MB")
+"""
+
+
+def test_flight_reader_streams_bounded_memory():
+    proc = subprocess.run(
+        [sys.executable, "-c", SCRIPT],
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=dict(CPU_MESH_ENV),
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-3000:]}"
+    )
+    assert "STREAM-OK" in proc.stdout

@@ -34,8 +34,13 @@ for name, t in data.items():
 
 # q11/q18/q20/q22 use spec constants that select nothing at SF=0.002 —
 # comparing empty-vs-empty is still a serde/stage-shape check, keep them.
+import os
+qlist = os.environ.get("BALLISTA_TEST_QUERIES")
+queries = (
+    [int(q) for q in qlist.split(",")] if qlist else list(range(1, 23))
+)
 mismatches = []
-for n in range(1, 23):
+for n in queries:
     sql = (QDIR / f"q{n}.sql").read_text()
     try:
         want = local.sql(sql).collect().to_pandas()
@@ -65,15 +70,29 @@ for n in range(1, 23):
     print(f"q{n}: {'ok' if not mismatches or mismatches[-1][0] != n else 'MISMATCH'}"
           f" ({len(want)} rows)")
 
+import jax
+
+if len(jax.devices()) >= 2:
+    # mesh-capable executor: the scheduler must have fused stage-chains
+    # onto the device mesh (VERDICT r4 item 3 / SURVEY build-order #6)
+    sched = dist._standalone_cluster.scheduler
+    stage_disp = "\n".join(
+        stage.plan.display()
+        for job in sched.jobs.values()
+        for stage in job.stages.values()
+    )
+    assert "MeshAggregateExec" in stage_disp, stage_disp[:4000]
+    assert "MeshJoinExec" in stage_disp, stage_disp[:4000]
+    print("MESH-STAGES-OK")
+
 dist.close()
 assert not mismatches, mismatches
 print("DISTRIBUTED-TPCH-OK")
 """
 
 
-def test_all_queries_distributed_match_local():
-    env = {k: v for k, v in CPU_MESH_ENV.items() if k != "XLA_FLAGS"}
-    proc = subprocess.run(
+def _run_distributed(env):
+    return subprocess.run(
         [sys.executable, "-c", SCRIPT],
         env=env,
         cwd=str(pathlib.Path(__file__).resolve().parent.parent),
@@ -81,7 +100,41 @@ def test_all_queries_distributed_match_local():
         text=True,
         timeout=1800,
     )
+
+
+def test_all_queries_distributed_match_local():
+    """Single-device executor: the file/Flight shuffle data plane."""
+    env = {k: v for k, v in CPU_MESH_ENV.items() if k != "XLA_FLAGS"}
+    proc = _run_distributed(env)
     assert proc.returncode == 0, (
         f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-3000:]}"
     )
     assert "DISTRIBUTED-TPCH-OK" in proc.stdout
+
+
+def test_distributed_match_local_mesh():
+    """Mesh-capable executor: the scheduler fuses stage-chains into
+    Mesh*Exec tasks; queries must still match the local tier, and mesh
+    operators must actually appear in stage plans.
+
+    Host-constrained coverage: this box exposes ONE core, and XLA's CPU
+    collective rendezvous hard-aborts the process (rendezvous.cc, fixed
+    40s window) whenever a program's per-device partition threads are not
+    SCHEDULED in time — 22 queries of cold shard_map compiles at 4-8
+    virtual devices trip it spuriously (observed at q8's 8-way join
+    plan). So: 4 virtual devices and a representative shape subset —
+    dense agg (q1), join+agg (q3), 6-way join (q5), filter-sum (q6),
+    join+projection agg (q14), semi-join (q18). The full 22 still run
+    distributed in the file-shuffle variant above, and the 8-device mesh
+    program shapes run in the driver's dryrun_multichip(8); on real
+    multi-chip hardware (cached compiles, real cores) the full sweep
+    applies."""
+    env = dict(CPU_MESH_ENV)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["BALLISTA_TEST_QUERIES"] = "1,3,5,6,14,18"
+    proc = _run_distributed(env)
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-3000:]}"
+    )
+    assert "DISTRIBUTED-TPCH-OK" in proc.stdout
+    assert "MESH-STAGES-OK" in proc.stdout
