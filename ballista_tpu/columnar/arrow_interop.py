@@ -82,8 +82,16 @@ def _column_to_np(
     col: pa.ChunkedArray | pa.Array,
     dtype: DataType,
     narrow: bool | None = None,
+    fixed_dict: Dictionary | None = None,
 ) -> tuple[np.ndarray, np.ndarray | None, Dictionary | None]:
-    """One Arrow column -> (device-repr np array, null mask or None, dict or None)."""
+    """One Arrow column -> (device-repr np array, null mask or None, dict or None).
+
+    ``fixed_dict``: encode a STRING column against this pre-built
+    dictionary instead of deriving one from the data — the streaming-scan
+    contract, where every slice of a larger file must agree on codes. A
+    value absent from the dictionary raises (the caller's pre-pass
+    understated the vocabulary); silent per-slice dictionaries would make
+    group-bys across slices merge unrelated strings."""
     if isinstance(col, pa.ChunkedArray):
         col = col.combine_chunks()
     null_mask = None
@@ -105,6 +113,18 @@ def _column_to_np(
         # device (ORDER BY and range predicates need no host round-trip).
         if pa.types.is_dictionary(col.type):
             col = col.cast(col.type.value_type)
+        if fixed_dict is not None:
+            sorted_uniq = pa.array(fixed_dict.values, type=pa.string())
+            codes_arr = pc.index_in(col, sorted_uniq)
+            if codes_arr.null_count > (
+                0 if null_mask is None else int(null_mask.sum())
+            ):
+                raise SchemaError(
+                    "streaming-scan dictionary is missing values present "
+                    "in a later slice"
+                )
+            codes = np.asarray(codes_arr.fill_null(0)).astype(np.int32)
+            return codes, null_mask, fixed_dict
         uniq = pc.unique(col).drop_null()
         sorted_uniq = uniq.take(pc.array_sort_indices(uniq))
         values = tuple(sorted_uniq.to_pylist())
@@ -194,6 +214,7 @@ def table_from_arrow(
     table: pa.Table,
     batch_rows: int,
     narrow_cols: frozenset | None = None,
+    fixed_dicts: dict | None = None,
 ) -> list[DeviceBatch]:
     """Slice an Arrow table into DeviceBatches of ≤batch_rows rows each,
     sharing one dictionary per STRING column (encoded table-wide first).
@@ -202,7 +223,11 @@ def table_from_arrow(
     (see narrowable_int64_cols). None = decide from THIS table; callers
     that convert slices of a larger whole must pass the whole-table set so
     layouts stay stable across slices. Empty frozenset disables narrowing
-    (the shuffle-read path, where different files must share layouts)."""
+    (the shuffle-read path, where different files must share layouts).
+
+    ``fixed_dicts``: {column name: Dictionary} pre-built dictionaries for
+    STRING columns — the streaming scan passes its whole-file vocabulary
+    so every slice encodes identical codes (see _column_to_np)."""
     schema = schema_from_arrow(table.schema)
     if narrow_cols is None:
         narrow_cols = narrowable_int64_cols(table)
@@ -210,7 +235,8 @@ def table_from_arrow(
     cols_np, nulls_np, dicts = [], [], {}
     for field, name in zip(schema, table.schema.names):
         arr, nm, d = _column_to_np(
-            table.column(name), field.dtype, narrow=name in narrow_cols
+            table.column(name), field.dtype, narrow=name in narrow_cols,
+            fixed_dict=(fixed_dicts or {}).get(name),
         )
         cols_np.append(arr)
         nulls_np.append(nm)

@@ -40,6 +40,7 @@ BALLISTA_PROFILE_DIR = "ballista.tpu.profile_dir"  # XLA profiler trace output
 BALLISTA_JOIN_EXPANSION = "ballista.tpu.join_expansion"  # probe-output expansion factor
 BALLISTA_BUILD_CACHE_MB = "ballista.tpu.build_cache_mb"  # join build-table HBM cache
 BALLISTA_COLLECTIVE_SHUFFLE = "ballista.tpu.collective_shuffle"  # on-pod all_to_all
+BALLISTA_SCAN_STREAM_MB = "ballista.tpu.scan_stream_mb"  # parquet streaming threshold
 
 
 class TaskSchedulingPolicy(Enum):
@@ -164,6 +165,18 @@ def _entries() -> dict[str, ConfigEntry]:
             "true",
             _parse_bool,
         ),
+        ConfigEntry(
+            BALLISTA_SCAN_STREAM_MB,
+            "Projected (post-pruning, post-projection) host-byte size above "
+            "which a parquet scan streams row-group slices through the "
+            "device instead of materializing + caching the whole table. "
+            "Keeps tables far larger than HBM (TPC-H SF=100) runnable on "
+            "one chip; 0 disables streaming. Materialized residency is "
+            "faster when the working set fits, so the threshold should stay "
+            "a healthy fraction of HBM.",
+            "4096",
+            int,
+        ),
     ]
     return {e.name: e for e in ents}
 
@@ -257,6 +270,9 @@ class BallistaConfig:
 
     def build_cache_mb(self) -> int:
         return self._get(BALLISTA_BUILD_CACHE_MB)
+
+    def scan_stream_mb(self) -> int:
+        return self._get(BALLISTA_SCAN_STREAM_MB)
 
     def collective_shuffle(self) -> bool:
         return self._get(BALLISTA_COLLECTIVE_SHUFFLE)

@@ -309,25 +309,28 @@ class MeshJoinExec(ExecutionPlan):
 
 
 class MeshSortExec(ExecutionPlan):
-    """ORDER BY ... LIMIT as a distributed TopK over the mesh (local
-    top-k per shard -> all_gather over ICI -> replicated merge), replacing
-    the CoalescePartitions -> SortExec funnel when a fetch bound exists.
-    The stage boundary it replaces is the reference's single-task sort
-    after a gather (ref scheduler planner.rs:104-132 coalesce split);
-    semantics mirror SortExec's fetch path (exec/sort.py)."""
+    """ORDER BY over the mesh. With a fetch bound: distributed TopK
+    (local top-k per shard -> all_gather over ICI -> replicated merge).
+    Without one: full sample sort (splitter sampling on the primary key ->
+    range all_to_all exchange -> local multi-key sort; the sharded output
+    read in index order IS the total order). Both replace the
+    CoalescePartitions -> SortExec funnel; the stage boundary they replace
+    is the reference's single-task sort after a gather (ref scheduler
+    planner.rs:104-132 coalesce split); fetch semantics mirror SortExec's
+    fetch path (exec/sort.py)."""
 
     def __init__(
         self,
         input: ExecutionPlan,
         sort_exprs,
-        fetch: int,
+        fetch: int | None,
         runtime: MeshRuntime,
     ) -> None:
         from ballista_tpu.ops.sort import resolve_sort_keys
 
         super().__init__()
-        if fetch is None or fetch <= 0:
-            raise PlanError("mesh sort requires a positive fetch bound")
+        if fetch is not None and fetch <= 0:
+            raise PlanError("mesh sort fetch bound must be positive")
         self.input = input
         self.sort_exprs = list(sort_exprs)
         self.fetch = fetch
@@ -343,17 +346,106 @@ class MeshSortExec(ExecutionPlan):
     def output_partitioning(self):
         return UnknownPartitioning(1)
 
+    @property
+    def sorted_output(self) -> bool:
+        """The live rows of the yielded batch are in total sort order
+        (consumers that gather to host preserve index order)."""
+        return True
+
     def describe(self) -> str:
         ks = ", ".join(
             f"{s.expr.name()} {'ASC' if s.ascending else 'DESC'}"
             for s in self.sort_exprs
         )
-        return (
-            f"MeshSortExec(ici-all_gather): [{ks}], fetch={self.fetch}"
+        mode = (
+            f"ici-all_gather, fetch={self.fetch}"
+            if self.fetch is not None
+            else "ici-sample-sort"
         )
+        return f"MeshSortExec({mode}): [{ks}]"
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
         batch = self.runtime.place(self.input, None, ctx)
         with self.metrics.time("sort_time"):
-            out = self.runtime.runner.topk(batch, self._keys, self.fetch)
+            if self.fetch is not None:
+                out = self.runtime.runner.topk(
+                    batch, self._keys, self.fetch
+                )
+            else:
+                out = self.runtime.runner.sort_full(batch, self._keys)
         yield out
+
+
+class MeshWindowExec(ExecutionPlan):
+    """Partition-keyed window functions over the mesh: hash-exchange rows
+    by the (shared) PARTITION BY key set so every partition lands whole on
+    one device, then run the single-device window programs per shard
+    inside the same compiled program (WindowExec.append_window_columns is
+    pure jax). Requires every window expression to share one non-empty
+    PARTITION BY column set — the planner falls back to the local gather
+    funnel otherwise. The reference has no distributed window path at all
+    (planner.rs:163-169 coalesces)."""
+
+    def __init__(
+        self, input: ExecutionPlan, window_exprs, names,
+        runtime: MeshRuntime,
+    ) -> None:
+        from ballista_tpu.exec.window import WindowExec
+
+        super().__init__()
+        self.input = input
+        self.runtime = runtime
+        # local operator: validation, schema, and the per-shard programs
+        self._local = WindowExec(input, window_exprs, names)
+        key_sets = {frozenset(pk) for pk, _ in self._local._keys}
+        if len(key_sets) != 1 or not next(iter(key_sets)):
+            raise PlanError(
+                "mesh windows require a single shared non-empty "
+                "PARTITION BY column set"
+            )
+        self._key_idxs = sorted(next(iter(key_sets)))
+        self._schema = self._local._schema
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def output_partitioning(self):
+        return UnknownPartitioning(1)
+
+    def describe(self) -> str:
+        return "Mesh" + self._local.describe()
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[DeviceBatch]:
+        batch = self.runtime.place(self.input, None, ctx)
+        in_schema = batch.schema
+        dicts = dict(batch.dictionaries)
+        local = self._local
+
+        def local_fn(cols, nulls, valid):
+            shard = DeviceBatch(
+                schema=in_schema,
+                columns=tuple(cols),
+                valid=valid,
+                nulls=tuple(nulls),
+                dictionaries=dicts,
+            )
+            return local.append_window_columns(shard)
+
+        with self.metrics.time("window_time"):
+            out_cols, out_nulls, out_valid = self.runtime.runner.window(
+                batch,
+                self._key_idxs,
+                local_fn,
+                n_out=len(local.names),
+                fn_key=("winfn", str(in_schema), local.describe()),
+            )
+        yield DeviceBatch(
+            schema=self._schema,
+            columns=tuple(out_cols),
+            valid=out_valid,
+            nulls=tuple(out_nulls),
+            dictionaries=dicts,
+        )

@@ -146,10 +146,26 @@ class PhysicalPlanner:
         if isinstance(node, P.Window):
             from ballista_tpu.exec.window import WindowExec
 
+            child = self._plan(node.input)
+            if self.mesh_runtime is not None:
+                # partition-keyed windows hash-exchange by PARTITION BY
+                # and run shard-local; exprs without a shared non-empty
+                # key set fall through to the gather funnel
+                from ballista_tpu.exec.mesh import MeshWindowExec
+
+                try:
+                    return MeshWindowExec(
+                        child,
+                        list(node.window_exprs),
+                        list(node.names),
+                        self.mesh_runtime,
+                    )
+                except PlanError:
+                    pass
             # WindowExec gathers all input partitions itself (a ranking
             # window needs every row of a partition in one place)
             return WindowExec(
-                self._plan(node.input),
+                child,
                 list(node.window_exprs),
                 list(node.names),
             )
@@ -172,6 +188,18 @@ class PhysicalPlanner:
             )
         if isinstance(node, P.Sort):
             child = self._plan(node.input)
+            if self.mesh_runtime is not None:
+                # full ORDER BY over the mesh: sample sort (range
+                # exchange + local sort) instead of the coalesce funnel
+                from ballista_tpu.exec.mesh import MeshSortExec
+
+                try:
+                    return MeshSortExec(
+                        child, list(node.sort_exprs), None,
+                        self.mesh_runtime,
+                    )
+                except PlanError:
+                    pass  # non-column keys: canonical funnel below
             if self.distributed and child.output_partitioning().n > 1:
                 # explicit gather boundary: the stage splitter cuts here, so
                 # an upstream K-way final aggregate keeps its K parallel

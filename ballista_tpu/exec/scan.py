@@ -472,9 +472,6 @@ class ParquetScanExec(ExecutionPlan):
         if not groups:
             yield DeviceBatch.empty(self._schema)
             return
-        dev_cache = None
-        t = None
-        hkey = None
         if self.scan_cache is not None:
             import os
 
@@ -485,6 +482,18 @@ class ParquetScanExec(ExecutionPlan):
             if self.scan_cache.get("mtime") != mt:
                 self.scan_cache.clear()  # rewritten file: drop both tiers
                 self.scan_cache["mtime"] = mt
+        stream_mb = ctx.config.scan_stream_mb()
+        if stream_mb:
+            gbytes = self._projected_group_bytes(f, groups)
+            if sum(gbytes) > stream_mb << 20:
+                yield from self._execute_streaming(
+                    f, groups, gbytes, ctx
+                )
+                return
+        dev_cache = None
+        t = None
+        hkey = None
+        if self.scan_cache is not None:
             sub = (tuple(groups), tuple(cols or ()))
             hkey = ("host",) + sub
             t = self.scan_cache.get(hkey)
@@ -504,6 +513,109 @@ class ParquetScanExec(ExecutionPlan):
         # partition's subset — partitions must share one physical layout
         mem.narrow_cols = self._narrowable_from_stats(f)
         yield from mem.execute(0, ctx)
+
+    # -- streaming (larger-than-memory) path --------------------------------
+
+    # Host bytes per streamed slice: a few row groups read + converted at a
+    # time, so peak host memory is one slice regardless of file size. Device
+    # batches are handed downstream one at a time; streaming consumers
+    # (partial aggregates, probe sides) fold and release them.
+    STREAM_SLICE_BYTES = 1 << 30
+
+    def _projected_group_bytes(
+        self, f: "papq.ParquetFile", groups: list[int]
+    ) -> list[int]:
+        """Uncompressed byte size of each row group restricted to the
+        projected columns — the memory the materialized path would commit."""
+        md = f.metadata
+        want = {fld.name for fld in self._schema}
+        out = []
+        for g in groups:
+            rg = md.row_group(g)
+            out.append(
+                sum(
+                    rg.column(ci).total_uncompressed_size
+                    for ci in range(rg.num_columns)
+                    if rg.column(ci).path_in_schema in want
+                )
+            )
+        return out
+
+    def _stream_dicts(self, f: "papq.ParquetFile") -> dict:
+        """Whole-file dictionary per projected STRING column, so every
+        streamed slice encodes identical codes (cached per registration —
+        the union pass reads just that column once)."""
+        import pyarrow.compute as pc
+
+        from ballista_tpu.columnar.batch import Dictionary
+
+        out = {}
+        for fld in self._schema:
+            if fld.dtype != DataType.STRING:
+                continue
+            key = ("sdict", fld.name)
+            d = (
+                self.scan_cache.get(key)
+                if self.scan_cache is not None
+                else None
+            )
+            if d is None:
+                vals: set = set()
+                with self.metrics.time("dict_scan_time"):
+                    for rb in f.iter_batches(
+                        columns=[fld.name], batch_size=1 << 20
+                    ):
+                        uniq = pc.unique(rb.column(0))
+                        if pa.types.is_dictionary(uniq.type):
+                            uniq = uniq.cast(uniq.type.value_type)
+                        vals.update(
+                            v for v in uniq.to_pylist() if v is not None
+                        )
+                d = Dictionary(tuple(sorted(vals)))
+                if self.scan_cache is not None:
+                    self.scan_cache[key] = d
+            out[fld.name] = d
+        return out
+
+    def _execute_streaming(
+        self,
+        f: "papq.ParquetFile",
+        groups: list[int],
+        gbytes: list[int],
+        ctx: TaskContext,
+    ) -> Iterator[DeviceBatch]:
+        batch_rows = self.batch_rows or ctx.config.tpu_batch_rows()
+        narrow = self._narrowable_from_stats(f)
+        dicts = self._stream_dicts(f)
+        self.metrics.add("stream_slices", 0)
+        names = [fld.name for fld in self._schema]
+        cur: list[int] = []
+        cur_b = 0
+        for g, gb in zip(groups, gbytes):
+            cur.append(g)
+            cur_b += gb
+            if cur_b >= self.STREAM_SLICE_BYTES:
+                yield from self._stream_slice(
+                    f, cur, names, batch_rows, narrow, dicts
+                )
+                cur, cur_b = [], 0
+        if cur:
+            yield from self._stream_slice(
+                f, cur, names, batch_rows, narrow, dicts
+            )
+
+    def _stream_slice(
+        self, f, groups, names, batch_rows, narrow, dicts
+    ) -> Iterator[DeviceBatch]:
+        with self.metrics.time("read_time"):
+            t = f.read_row_groups(groups, columns=self.projection or None)
+        t = t.select(names)
+        self.metrics.add("stream_slices")
+        for b in table_from_arrow(
+            t, batch_rows, narrow, fixed_dicts=dicts
+        ):
+            self.metrics.add("output_rows", b.count_valid())
+            yield b
 
     def _narrowable_from_stats(self, f: "papq.ParquetFile") -> frozenset:
         """INT64 columns whose min/max over EVERY row group (from parquet

@@ -409,6 +409,20 @@ class WindowExec(ExecutionPlan):
         if not batches:
             return
         b = concat_batches(batches) if len(batches) > 1 else batches[0]
+        out_cols, out_nulls = self.append_window_columns(b)
+        yield DeviceBatch(
+            schema=self._schema,
+            columns=tuple(out_cols),
+            valid=b.valid,
+            nulls=tuple(out_nulls),
+            dictionaries=dict(b.dictionaries),
+        )
+
+    def append_window_columns(self, b: DeviceBatch):
+        """Input batch -> (columns + appended window columns, null masks).
+        Pure-jax given the batch (the finisher programs are jitted and
+        inline when traced), so MeshWindowExec can run it per shard inside
+        a ``shard_map`` after the partition-key exchange."""
         out_cols = list(b.columns)
         out_nulls = list(b.nulls)
         perm_cache: dict = {}  # shared sort for identical key sets
@@ -497,10 +511,4 @@ class WindowExec(ExecutionPlan):
                 )
             out_cols.append(vals)
             out_nulls.append(nulls)
-        yield DeviceBatch(
-            schema=self._schema,
-            columns=tuple(out_cols),
-            valid=b.valid,
-            nulls=tuple(out_nulls),
-            dictionaries=dict(b.dictionaries),
-        )
+        return out_cols, out_nulls

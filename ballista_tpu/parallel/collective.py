@@ -28,11 +28,24 @@ def bucket_rows(
     bucket_cap: int,
 ) -> tuple[tuple, tuple, jnp.ndarray, jnp.ndarray]:
     """Scatter local rows into ``n_parts`` contiguous buckets of
-    ``bucket_cap`` slots each. Returns (cols, nulls, valid, overflow) with
-    row axis ``n_parts * bucket_cap``."""
+    ``bucket_cap`` slots each by KEY HASH. Returns (cols, nulls, valid,
+    overflow) with row axis ``n_parts * bucket_cap``."""
     key_cols = [cols[i] for i in key_positions]
     key_nulls = [nulls[i] for i in key_positions]
     pid = partition_ids_for(key_cols, key_nulls, valid, n_parts)
+    return bucket_rows_by_pid(cols, nulls, valid, pid, n_parts, bucket_cap)
+
+
+def bucket_rows_by_pid(
+    cols: tuple[jnp.ndarray, ...],
+    nulls: tuple[jnp.ndarray | None, ...],
+    valid: jnp.ndarray,
+    pid: jnp.ndarray,
+    n_parts: int,
+    bucket_cap: int,
+) -> tuple[tuple, tuple, jnp.ndarray, jnp.ndarray]:
+    """bucket_rows with caller-computed partition ids (``pid >= n_parts``
+    drops the row) — the range-exchange entry the mesh sample sort uses."""
     perm = multi_key_perm([(pid, False)])
     pid_s = pid[perm]
     starts = _ss(pid_s, jnp.arange(n_parts, dtype=pid_s.dtype))

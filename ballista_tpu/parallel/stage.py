@@ -57,7 +57,11 @@ from ballista_tpu.ops.join import (
     probe_counts,
 )
 from ballista_tpu.ops.perm import multi_key_perm
-from ballista_tpu.parallel.collective import exchange_by_key
+from ballista_tpu.parallel.collective import (
+    all_to_all_rows,
+    bucket_rows_by_pid,
+    exchange_by_key,
+)
 from ballista_tpu.parallel.mesh import SHARD_AXIS
 
 MAX_MESH_RETRIES = 6
@@ -335,6 +339,248 @@ class MeshStageRunner:
             tuple(P() for _ in range(n)),
             tuple(P() for _ in range(n)),
             P(),
+        )
+        sm = shard_map(
+            f, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+        return jax.jit(sm)
+
+    # -- full sort (sample sort / range exchange) -----------------------------
+
+    SORT_SAMPLES = 64  # splitter samples per device
+
+    def sort_full(self, batch: DeviceBatch, keys) -> DeviceBatch:
+        """Total ORDER BY (no LIMIT) over the mesh: sample split points on
+        the primary key -> range all_to_all exchange -> local multi-key
+        sort per shard. Device d ends up holding the d-th key range,
+        locally sorted, so the sharded batch read in index order IS the
+        total order (ties on the primary key route to one device and are
+        broken there by the remaining keys). The reference serializes this
+        shape through a single post-gather sort task (planner.rs:104-132);
+        the mesh version never funnels.
+
+        Skew (few distinct primary keys) shows up as bucket overflow and
+        retries with grown bucket capacity up to the skew-proof bound
+        (per-shard rows, where overflow is impossible)."""
+        key_sig = tuple(
+            (kk.col, kk.ascending, kk.nulls_first) for kk in keys
+        )
+        per = max(1, batch.capacity // self.n_dev)
+        bcap = round_capacity(max(1, (2 * per) // self.n_dev))
+        for attempt in range(MAX_MESH_RETRIES):
+            bcap = min(bcap, round_capacity(per))
+            prog = self._sort_full_program(batch, key_sig, bcap)
+            with _COLLECTIVE_LOCK:
+                out_cols, out_nulls, out_valid, ovf = prog(
+                    batch.columns, batch.nulls, batch.valid
+                )
+                from ballista_tpu.ops.fetch import fetch_arrays
+
+                (ovf_h,) = fetch_arrays([ovf])
+                jax.block_until_ready(out_valid)
+            if not np.any(ovf_h):
+                break
+            if bcap >= per or attempt == MAX_MESH_RETRIES - 1:
+                raise CapacityError(
+                    "mesh sort bucket overflow after retries",
+                    required=per * self.n_dev,
+                )
+            bcap *= 2
+        return DeviceBatch(
+            schema=batch.schema,
+            columns=tuple(out_cols),
+            valid=out_valid,
+            nulls=tuple(out_nulls),
+            dictionaries=dict(batch.dictionaries),
+        )
+
+    def _sort_full_program(self, batch, key_sig, bcap):
+        key = (
+            "sortf", str(batch.schema), batch.capacity, key_sig, bcap,
+            tuple(m is None for m in batch.nulls),
+        )
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._compile_sort_full(batch, key_sig, bcap)
+            self._programs[key] = prog
+        return prog
+
+    def _compile_sort_full(self, batch, key_sig, bcap):
+        from ballista_tpu.ops.perm import take_batch
+        from ballista_tpu.ops.sort import SortKey, sort_passes
+
+        axis, n_dev = self.axis, self.n_dev
+        keys = [
+            SortKey(col=c, ascending=a, nulls_first=nf)
+            for c, a, nf in key_sig
+        ]
+        k0 = keys[0]
+        S = self.SORT_SAMPLES
+
+        def routing_key(cols, nulls):
+            """Primary sort key as a widened scalar whose ASCENDING order
+            equals the key's sort order: DESC flips sign, null-masked rows
+            pin to the end the key's null placement dictates."""
+            r = cols[k0.col]
+            nm = nulls[k0.col]
+            if jnp.issubdtype(r.dtype, jnp.floating):
+                r = r.astype(jnp.float64)
+                hi = jnp.array(jnp.inf, r.dtype)
+                # raw NaNs (not null-masked) sort last like jnp.sort
+                r = jnp.where(jnp.isnan(r), hi, r)
+            elif r.dtype == jnp.dtype(bool):
+                r = r.astype(jnp.int64)
+                hi = jnp.array(jnp.iinfo(jnp.int64).max, r.dtype)
+            else:
+                r = r.astype(jnp.int64)
+                hi = jnp.array(jnp.iinfo(jnp.int64).max, r.dtype)
+            lo = -hi
+            if not k0.ascending:
+                r = -r
+            if nm is not None:
+                r = jnp.where(nm, lo if k0.nulls_first else hi, r)
+            return r, hi
+
+        def f(cols, nulls, valid):
+            per = valid.shape[0]
+            r, hi = routing_key(cols, nulls)
+            # dead rows route nowhere; use the sentinel so local sorted
+            # samples see only live keys in the prefix
+            r_live = jnp.where(valid, r, hi)
+            rs = jnp.sort(r_live)
+            nlive = jnp.sum(valid).astype(jnp.int32)
+            pos = jnp.clip(
+                (jnp.arange(S, dtype=jnp.int32) * nlive) // S, 0, per - 1
+            )
+            samp = jnp.where(nlive > 0, rs[pos], hi)
+            gs = jnp.sort(jax.lax.all_gather(samp, axis, tiled=True))
+            tot = S * n_dev
+            spl_pos = (
+                jnp.arange(1, n_dev, dtype=jnp.int32) * tot
+            ) // n_dev
+            splitters = gs[spl_pos]
+            pid = jnp.searchsorted(splitters, r_live, side="left").astype(
+                jnp.int32
+            )
+            pid = jnp.where(valid, pid, n_dev)
+            bcols, bnulls, bvalid, ovf = bucket_rows_by_pid(
+                cols, nulls, valid, pid, n_dev, bcap
+            )
+            ecols, enulls, evalid = all_to_all_rows(
+                bcols, bnulls, bvalid, axis, n_dev, bcap
+            )
+            perm = multi_key_perm(
+                sort_passes(list(ecols), list(enulls), evalid, keys)
+            )
+            ocols, onulls, ovalid = take_batch(
+                list(ecols), list(enulls), evalid, perm
+            )
+            out_nulls = tuple(
+                jnp.zeros(c.shape[0], dtype=bool) if m is None else m
+                for c, m in zip(ocols, onulls)
+            )
+            return tuple(ocols), out_nulls, ovalid, ovf.reshape(1)
+
+        in_specs = (
+            self._leaf_specs(batch.columns),
+            self._leaf_specs(batch.nulls),
+            P(axis),
+        )
+        n = len(batch.columns)
+        out_specs = (
+            tuple(P(axis) for _ in range(n)),
+            tuple(P(axis) for _ in range(n)),
+            P(axis),
+            P(axis),
+        )
+        sm = shard_map(
+            f, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+        return jax.jit(sm)
+
+    # -- partition-keyed windows ----------------------------------------------
+
+    def window(self, batch: DeviceBatch, key_idxs: list[int], local_fn,
+               n_out: int, fn_key=None):
+        """Partition-keyed window functions over the mesh: hash-exchange
+        rows by PARTITION BY key so each partition lands whole on one
+        device, then run ``local_fn`` — the single-device window program —
+        per shard inside the same compiled program. The reference punts on
+        distributed windows entirely (planner.rs:163-169 funnels through a
+        coalesce); this keeps K-way parallelism.
+
+        ``local_fn(cols, nulls, valid) -> (out_cols, out_nulls)`` must be
+        traceable and return the INPUT columns plus ``n_out`` appended
+        window columns (null mask per appended column or None)."""
+        per = max(1, batch.capacity // self.n_dev)
+        bcap = round_capacity(max(1, (2 * per) // self.n_dev))
+        for attempt in range(MAX_MESH_RETRIES):
+            bcap = min(bcap, round_capacity(per))
+            prog = self._window_program(
+                batch, tuple(key_idxs), local_fn, n_out, bcap, fn_key
+            )
+            with _COLLECTIVE_LOCK:
+                out_cols, out_nulls, out_valid, ovf = prog(
+                    batch.columns, batch.nulls, batch.valid
+                )
+                from ballista_tpu.ops.fetch import fetch_arrays
+
+                (ovf_h,) = fetch_arrays([ovf])
+                jax.block_until_ready(out_valid)
+            if not np.any(ovf_h):
+                break
+            if bcap >= per or attempt == MAX_MESH_RETRIES - 1:
+                raise CapacityError(
+                    "mesh window bucket overflow after retries",
+                    required=per * self.n_dev,
+                )
+            bcap *= 2
+        return out_cols, out_nulls, out_valid
+
+    def _window_program(self, batch, key_idxs, local_fn, n_out, bcap,
+                        fn_key=None):
+        key = (
+            "window", str(batch.schema), batch.capacity, key_idxs,
+            fn_key if fn_key is not None else id(local_fn), n_out, bcap,
+            tuple(m is None for m in batch.nulls),
+        )
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._compile_window(
+                batch, key_idxs, local_fn, n_out, bcap
+            )
+            self._programs[key] = prog
+        return prog
+
+    def _compile_window(self, batch, key_idxs, local_fn, n_out, bcap):
+        axis, n_dev = self.axis, self.n_dev
+
+        def f(cols, nulls, valid):
+            ecols, enulls, evalid, ovf = exchange_by_key(
+                cols, nulls, valid, key_idxs, axis, n_dev, bcap
+            )
+            out_cols, out_nulls = local_fn(
+                list(ecols), list(enulls), evalid
+            )
+            out_nulls = tuple(
+                jnp.zeros(c.shape[0], dtype=bool) if m is None else m
+                for c, m in zip(out_cols, out_nulls)
+            )
+            return tuple(out_cols), out_nulls, evalid, ovf.reshape(1)
+
+        in_specs = (
+            self._leaf_specs(batch.columns),
+            self._leaf_specs(batch.nulls),
+            P(axis),
+        )
+        n = len(batch.columns) + n_out
+        out_specs = (
+            tuple(P(axis) for _ in range(n)),
+            tuple(P(axis) for _ in range(n)),
+            P(axis),
+            P(axis),
         )
         sm = shard_map(
             f, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,

@@ -25,48 +25,134 @@ BALLISTA_VERSION = "0.6.0-tpu"
 _UI_PAGE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>ballista-tpu scheduler</title>
 <style>
- body { font-family: system-ui, sans-serif; margin: 2rem; color: #1a1a2e; }
- h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
- table { border-collapse: collapse; min-width: 40rem; }
- th, td { text-align: left; padding: .35rem .8rem; border-bottom: 1px solid #ddd; }
- th { background: #f4f4f8; }
- .muted { color: #777; font-size: .85rem; }
+ :root { --ink:#1a1a2e; --mut:#6b7280; --line:#e5e7eb; --bg:#f8f9fb;
+         --ok:#15803d; --run:#1d4ed8; --bad:#b91c1c; --pend:#92400e; }
+ body { font-family: system-ui, sans-serif; margin: 0; color: var(--ink);
+        background: var(--bg); }
+ header { background: #111827; color: #f9fafb; padding: .8rem 1.5rem;
+          display: flex; align-items: baseline; gap: 1rem; }
+ header h1 { font-size: 1.1rem; margin: 0; }
+ header .muted { color: #9ca3af; font-size: .8rem; }
+ main { padding: 1rem 1.5rem 3rem; max-width: 72rem; margin: 0 auto; }
+ .tiles { display: grid; grid-template-columns: repeat(auto-fit, minmax(9rem,1fr));
+          gap: .8rem; margin: 1rem 0 1.5rem; }
+ .tile { background: #fff; border: 1px solid var(--line); border-radius: .5rem;
+         padding: .7rem .9rem; }
+ .tile .v { font-size: 1.45rem; font-weight: 600; }
+ .tile .l { color: var(--mut); font-size: .75rem; text-transform: uppercase;
+            letter-spacing: .05em; }
+ h2 { font-size: .95rem; margin: 1.4rem 0 .5rem; }
+ table { border-collapse: collapse; width: 100%; background: #fff;
+         border: 1px solid var(--line); border-radius: .5rem; overflow: hidden; }
+ th, td { text-align: left; padding: .4rem .8rem;
+          border-bottom: 1px solid var(--line); font-size: .85rem; }
+ th { background: #f3f4f6; font-weight: 600; }
+ tr:last-child td { border-bottom: none; }
+ .muted { color: var(--mut); font-size: .85rem; }
+ .pill { display: inline-block; border-radius: 999px; padding: .05rem .55rem;
+         font-size: .72rem; font-weight: 600; }
+ .pill.completed { background: #dcfce7; color: var(--ok); }
+ .pill.running   { background: #dbeafe; color: var(--run); }
+ .pill.failed    { background: #fee2e2; color: var(--bad); }
+ .pill.queued, .pill.pending { background: #fef3c7; color: var(--pend); }
+ .bar { background: var(--line); border-radius: 999px; height: .5rem;
+        min-width: 7rem; overflow: hidden; }
+ .bar > div { background: var(--run); height: 100%; }
+ .bar.done > div { background: var(--ok); }
+ details.job { margin: 0; }
+ .stageplan { font-family: ui-monospace, monospace; font-size: .75rem;
+              white-space: pre; overflow-x: auto; background: #f9fafb;
+              border: 1px solid var(--line); border-radius: .35rem;
+              padding: .5rem .7rem; margin: .3rem 0 .7rem; }
+ .dag { font-size: .8rem; color: var(--mut); margin: .2rem 0 .4rem; }
+ td.exp { cursor: pointer; color: var(--run); user-select: none; }
 </style></head>
 <body>
-<h1>ballista-tpu scheduler</h1>
-<div class="muted" id="meta"></div>
+<header><h1>ballista-tpu scheduler</h1><div class="muted" id="meta"></div></header>
+<main>
+<div class="tiles">
+ <div class="tile"><div class="v" id="t-exec">–</div><div class="l">executors alive</div></div>
+ <div class="tile"><div class="v" id="t-slots">–</div><div class="l">slots free / total</div></div>
+ <div class="tile"><div class="v" id="t-dev">–</div><div class="l">mesh devices</div></div>
+ <div class="tile"><div class="v" id="t-running">–</div><div class="l">jobs running</div></div>
+ <div class="tile"><div class="v" id="t-done">–</div><div class="l">jobs completed</div></div>
+ <div class="tile"><div class="v" id="t-failed">–</div><div class="l">jobs failed</div></div>
+</div>
 <h2>Executors</h2>
 <table id="executors"><thead><tr>
- <th>id</th><th>host</th><th>flight port</th><th>slots (free/total)</th><th>last seen</th>
+ <th>id</th><th>host</th><th>flight port</th><th>devices</th>
+ <th>slots (free/total)</th><th>last seen</th>
 </tr></thead><tbody></tbody></table>
 <h2>Jobs</h2>
 <table id="jobs"><thead><tr>
- <th>job id</th><th>status</th><th>stages</th><th>tasks (done/total)</th><th>stage detail</th><th>error</th>
+ <th></th><th>job id</th><th>status</th><th>stages</th><th>progress</th>
+ <th>stage detail</th><th>error</th>
 </tr></thead><tbody></tbody></table>
+</main>
 <script>
 // textContent only — job errors echo user SQL fragments, never as HTML
-function row(tbody, cells) {
-  const tr = document.createElement('tr');
-  for (const c of cells) {
-    const td = document.createElement('td');
-    td.textContent = c;
-    tr.appendChild(td);
+function td(parent, text, cls) {
+  const el = document.createElement('td');
+  if (cls) el.className = cls;
+  el.textContent = text;
+  parent.appendChild(el);
+  return el;
+}
+function pill(state) {
+  const s = document.createElement('span');
+  s.className = 'pill ' + state;
+  s.textContent = state;
+  return s;
+}
+const open = new Set();
+async function expand(jobId, tr, ncols) {
+  if (open.has(jobId)) { open.delete(jobId); tr.nextSibling?.remove(); return; }
+  open.add(jobId);
+  const r = await fetch('api/job/' + encodeURIComponent(jobId));
+  if (!r.ok) return;
+  const d = await r.json();
+  const drow = document.createElement('tr');
+  const cell = document.createElement('td');
+  cell.colSpan = ncols;
+  for (const st of d.stages) {
+    const h = document.createElement('div');
+    h.className = 'dag';
+    h.textContent = `stage ${st.stage_id}` +
+      (st.depends_on.length ? ` ⇐ depends on [${st.depends_on.join(', ')}]` : ' (leaf)') +
+      (st.stage_id === d.final_stage_id ? '  · final' : '');
+    cell.appendChild(h);
+    const pre = document.createElement('div');
+    pre.className = 'stageplan';
+    pre.textContent = st.plan;
+    cell.appendChild(pre);
   }
-  tbody.appendChild(tr);
+  drow.appendChild(cell);
+  tr.after(drow);
 }
 async function refresh() {
   const r = await fetch('api/state'); const s = await r.json();
   document.getElementById('meta').textContent =
-    `version ${s.version} — up ${Math.round(s.uptime_seconds)}s — policy ${s.policy}`;
+    `v${s.version} · up ${Math.round(s.uptime_seconds)}s · policy ${s.policy}`;
+  let free = 0, total = 0, dev = 0;
   const ex = document.querySelector('#executors tbody'); ex.innerHTML = '';
   for (const e of s.executors) {
-    row(ex, [e.id, e.host, e.port,
-      `${e.available_task_slots ?? '-'} / ${e.total_task_slots ?? '-'}`,
-      e.last_seen_seconds_ago == null ? 'never'
-        : e.last_seen_seconds_ago.toFixed(1) + 's ago']);
+    free += e.available_task_slots ?? 0; total += e.total_task_slots ?? 0;
+    dev += e.n_devices ?? 1;
+    const tr = document.createElement('tr');
+    td(tr, e.id); td(tr, e.host); td(tr, e.port);
+    td(tr, e.n_devices ?? 1);
+    td(tr, `${e.available_task_slots ?? '-'} / ${e.total_task_slots ?? '-'}`);
+    td(tr, e.last_seen_seconds_ago == null ? 'never'
+        : e.last_seen_seconds_ago.toFixed(1) + 's ago');
+    ex.appendChild(tr);
   }
+  document.getElementById('t-exec').textContent = s.executors.length;
+  document.getElementById('t-slots').textContent = `${free} / ${total}`;
+  document.getElementById('t-dev').textContent = dev;
+  const counts = {running: 0, completed: 0, failed: 0};
   const jb = document.querySelector('#jobs tbody'); jb.innerHTML = '';
   for (const j of s.jobs) {
+    counts[j.status] = (counts[j.status] ?? 0) + 1;
     const stages = j.stages || [];
     let done = 0, total = 0;
     const detail = stages.map(st => {
@@ -75,11 +161,31 @@ async function refresh() {
         (st.state === 'running'
           ? ` (${st.tasks.completed}/${st.n_tasks})` : '');
     }).join('  ');
+    const tr = document.createElement('tr');
+    const e = td(tr, open.has(j.job_id) ? '▾' : '▸', 'exp');
+    e.onclick = () => expand(j.job_id, tr, 7).then(refreshCaret);
+    function refreshCaret() { e.textContent = open.has(j.job_id) ? '▾' : '▸'; }
+    td(tr, j.job_id);
+    td(tr, '').appendChild(pill(j.status));
+    td(tr, j.n_stages);
     // finished jobs have their stage bookkeeping torn down — no counts
-    row(jb, [j.job_id, j.status, j.n_stages,
-             stages.length ? `${done} / ${total}` : '-',
-             detail, j.error || '']);
+    const pc = td(tr, '');
+    if (j.status === 'completed' || (total > 0)) {
+      const bar = document.createElement('div');
+      bar.className = 'bar' + (j.status === 'completed' ? ' done' : '');
+      const fill = document.createElement('div');
+      fill.style.width = (j.status === 'completed' ? 100
+        : total ? Math.round(100 * done / total) : 0) + '%';
+      bar.appendChild(fill); pc.appendChild(bar);
+    } else pc.textContent = '-';
+    td(tr, detail);
+    td(tr, j.error || '');
+    jb.appendChild(tr);
+    if (open.has(j.job_id)) { open.delete(j.job_id); expand(j.job_id, tr, 7); }
   }
+  document.getElementById('t-running').textContent = counts.running ?? 0;
+  document.getElementById('t-done').textContent = counts.completed ?? 0;
+  document.getElementById('t-failed').textContent = counts.failed ?? 0;
 }
 refresh(); setInterval(refresh, 2000);
 </script>
@@ -101,6 +207,7 @@ def scheduler_state(server) -> dict:
                 "host": em.host,
                 "port": em.port,
                 "grpc_port": em.grpc_port,
+                "n_devices": em.specification.n_devices or 1,
                 "total_task_slots": data.total_task_slots if data else None,
                 "available_task_slots": (
                     data.available_task_slots if data else None
@@ -134,14 +241,54 @@ def scheduler_state(server) -> dict:
     }
 
 
+def job_detail(server, job_id: str) -> dict | None:
+    """Per-job stage DAG detail for the UI's expandable rows: stage
+    dependency edges + the physical plan display of every stage (the
+    reference UI's query-detail view, ballista/ui stage tables)."""
+    with server._lock:
+        job = server.jobs.get(job_id)
+        if job is None:
+            return None
+        stages = []
+        for sid in sorted(job.stages):
+            deps = sorted(
+                child
+                for child, parents in job.dependencies.items()
+                if sid in parents
+            )
+            stages.append(
+                {
+                    "stage_id": sid,
+                    "depends_on": deps,
+                    "plan": job.stages[sid].plan.display(),
+                }
+            )
+        return {
+            "job_id": job_id,
+            "status": job.status,
+            "final_stage_id": job.final_stage_id,
+            "stages": stages,
+        }
+
+
 def start_rest_server(server, host: str = "0.0.0.0", port: int = 0):
-    """Serve /api/state + the status page. Returns (httpd, bound_port)."""
+    """Serve /api/state, /api/job/<id> + the status page. Returns
+    (httpd, bound_port)."""
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (http.server API)
             path = self.path.split("?", 1)[0].rstrip("/") or "/"
             if path in ("/api/state", "/state"):
                 body = json.dumps(scheduler_state(server)).encode()
+                ctype = "application/json"
+            elif path.startswith("/api/job/"):
+                from urllib.parse import unquote
+
+                detail = job_detail(server, unquote(path[len("/api/job/"):]))
+                if detail is None:
+                    self.send_error(404)
+                    return
+                body = json.dumps(detail).encode()
                 ctype = "application/json"
             elif path == "/":
                 body = _UI_PAGE.encode()

@@ -1,0 +1,361 @@
+#!/usr/bin/env python
+"""Chunked TPC-H parquet generator for large scale factors (SF>=10).
+
+The in-process generator (ballista_tpu/tpch.py, ref
+benchmarks/tpch-gen.sh's dockerised dbgen) builds whole tables in memory
+with Python-string columns — infeasible at SF=100 (600M lineitem rows).
+This writer generates each table in fixed-size row chunks with a
+deterministic per-(table, chunk) RNG stream and appends them to one
+parquet file per table, so peak memory is one chunk (~8M rows) no matter
+the SF.
+
+Large-SF deviations from the small-SF generator (documented, bench-only):
+- free-text columns (comments, addresses, clerk, phone) draw from a small
+  precomputed vocabulary and are written dictionary-encoded — the TPC-H
+  queries this dataset serves (q1/q3/q5/q6/q18, BASELINE.md configs 4-5)
+  never read them, and real per-row text would dominate generation time
+  and double the file size;
+- `part`/`partsupp` are only written when explicitly requested (the
+  headline query set touches neither).
+
+Key relationships and value domains (PK/FK integrity, price formula,
+date windows, returnflag/linestatus derivation) match ballista_tpu/tpch.py
+so plans, pruning, and kernels see spec-shaped data.
+
+Usage:
+  python -m benchmarks.gen_parquet --scale 100 --path .data/tpch_sf100 \
+      [--tables lineitem,orders,...] [--chunk-rows 8000000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import pathlib
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as papq
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from ballista_tpu.tpch import (  # noqa: E402
+    _CARD,
+    COMMENT_WORDS,
+    DATE_HI,
+    DATE_LO,
+    NATIONS,
+    PRIORITIES,
+    REGIONS,
+    SEGMENTS,
+    SHIPINSTRUCT,
+    SHIPMODES,
+    TPCH_TABLES,
+    _d,
+    gen_table,
+    tpch_schema,
+)
+
+EPOCH = datetime.date(1970, 1, 1)
+
+# Small fixed vocabularies for free-text columns (see module docstring).
+_VOCAB_RNG = np.random.default_rng(7)
+_COMMENT_VOCAB = [
+    " ".join(
+        COMMENT_WORDS[j]
+        for j in _VOCAB_RNG.integers(0, len(COMMENT_WORDS), 5)
+    )
+    for _ in range(1024)
+]
+_CLERK_VOCAB = [f"Clerk#{i:09d}" for i in range(1, 1001)]
+_PHONE_VOCAB = [
+    f"{10 + int(n)}-{_VOCAB_RNG.integers(100, 1000)}-"
+    f"{_VOCAB_RNG.integers(100, 1000)}-{_VOCAB_RNG.integers(1000, 10000)}"
+    for n in _VOCAB_RNG.integers(0, 25, 512)
+]
+
+
+def _dict_col(codes: np.ndarray, vocab: list[str]) -> pa.Array:
+    return pa.DictionaryArray.from_arrays(
+        pa.array(codes.astype(np.int32)), pa.array(vocab)
+    )
+
+
+def _date_col(days: np.ndarray) -> pa.Array:
+    return pa.array(days.astype(np.int32), type=pa.date32())
+
+
+def _rng(seed: int, table: str, chunk: int) -> np.random.Generator:
+    return np.random.default_rng(
+        np.random.SeedSequence([seed, TPCH_TABLES.index(table), chunk])
+    )
+
+
+def _arrow_schema(table: str) -> pa.schema:
+    """Arrow schema matching ballista_tpu's engine schema dtypes."""
+    m = {
+        "int64": pa.int64(),
+        "int32": pa.int32(),
+        "float64": pa.float64(),
+        "string": pa.string(),
+        "date32": pa.date32(),
+    }
+    return pa.schema(
+        [
+            pa.field(f.name, m[f.dtype.value], nullable=False)
+            for f in tpch_schema(table)
+        ]
+    )
+
+
+class _Writer:
+    """ParquetWriter that normalizes dictionary columns to the declared
+    utf8 schema lazily per chunk (parquet dictionary-encodes on disk
+    regardless; keeping the logical type utf8 matches the engine schema)."""
+
+    def __init__(self, path: pathlib.Path, table: str, row_group: int):
+        self.schema = _arrow_schema(table)
+        self.w = papq.ParquetWriter(
+            str(path), self.schema, compression="snappy"
+        )
+        self.row_group = row_group
+        self.rows = 0
+
+    def write(self, cols: dict) -> None:
+        arrs = []
+        for f in self.schema:
+            a = cols[f.name]
+            if isinstance(a, np.ndarray):
+                a = pa.array(a)
+            if pa.types.is_dictionary(a.type):
+                a = a.cast(pa.string()) if f.type == pa.string() else a
+            arrs.append(a)
+        t = pa.table(
+            dict(zip([f.name for f in self.schema], arrs))
+        ).cast(self.schema)
+        self.w.write_table(t, row_group_size=self.row_group)
+        self.rows += t.num_rows
+
+    def close(self) -> None:
+        self.w.close()
+
+
+def _orders_chunk(scale: float, seed: int, start: int, n: int, ncust: int):
+    """Rows [start, start+n) of orders, deterministic per chunk index
+    (chunk index = start // chunk size, passed via the caller's rng)."""
+    rng = _rng(seed, "orders", start)
+    keys = (np.arange(start, start + n, dtype=np.int64) * 4) + 1
+    ck = rng.integers(1, ncust + 1, n).astype(np.int64)
+    odate = rng.integers(DATE_LO, DATE_HI - 151, n).astype(np.int32)
+    return rng, keys, ck, odate
+
+
+def gen_orders_chunks(scale: float, seed: int, chunk_rows: int):
+    ncust = max(1, int(_CARD["customer"] * scale))
+    n = max(1, int(_CARD["orders"] * scale))
+    for start in range(0, n, chunk_rows):
+        m = min(chunk_rows, n - start)
+        rng, keys, ck, odate = _orders_chunk(scale, seed, start, m, ncust)
+        status_codes = np.where(
+            odate + 100 < _d(1995, 6, 17),
+            0,
+            np.where(odate > _d(1996, 1, 1), 1, 2),
+        )
+        yield {
+            "o_orderkey": keys,
+            "o_custkey": ck,
+            "o_orderstatus": _dict_col(status_codes, ["F", "O", "P"]),
+            "o_totalprice": np.round(rng.uniform(850.0, 555000.0, m), 2),
+            "o_orderdate": _date_col(odate),
+            "o_orderpriority": _dict_col(
+                rng.integers(0, 5, m), PRIORITIES
+            ),
+            "o_clerk": _dict_col(
+                rng.integers(0, len(_CLERK_VOCAB), m), _CLERK_VOCAB
+            ),
+            "o_shippriority": np.zeros(m, dtype=np.int32),
+            "o_comment": _dict_col(
+                rng.integers(0, len(_COMMENT_VOCAB), m), _COMMENT_VOCAB
+            ),
+        }
+
+
+def gen_lineitem_chunks(scale: float, seed: int, chunk_rows: int):
+    """Lineitem chunks aligned to orders chunks: chunk i covers the
+    lineitems of orders rows [i*chunk_rows, (i+1)*chunk_rows)."""
+    ncust = max(1, int(_CARD["customer"] * scale))
+    npart = max(1, int(_CARD["part"] * scale))
+    nsupp = max(1, int(_CARD["supplier"] * scale))
+    norders = max(1, int(_CARD["orders"] * scale))
+    for start in range(0, norders, chunk_rows):
+        m = min(chunk_rows, norders - start)
+        _, okeys, _, odates = _orders_chunk(scale, seed, start, m, ncust)
+        rng = _rng(seed, "lineitem", start)
+        nline = rng.integers(1, 8, m)
+        lok = np.repeat(okeys, nline)
+        lod = np.repeat(odates, nline)
+        n = len(lok)
+        # per-order line numbers without a Python loop:
+        ends = np.cumsum(nline)
+        linenumber = (
+            np.arange(n, dtype=np.int64) - np.repeat(ends - nline, nline) + 1
+        ).astype(np.int32)
+        pk = rng.integers(1, npart + 1, n).astype(np.int64)
+        i4 = rng.integers(0, 4, n).astype(np.int64)
+        sk = (pk + i4 * (nsupp // 4 + ((pk - 1) // nsupp))) % nsupp + 1
+        qty = rng.integers(1, 51, n).astype(np.float64)
+        retail = (90000 + (pk % 20001) + 100 * (pk % 1000)) / 100.0
+        eprice = np.round(retail * qty, 2)
+        sdate = (lod + rng.integers(1, 122, n)).astype(np.int32)
+        cdate = (lod + rng.integers(30, 91, n)).astype(np.int32)
+        rdate = (sdate + rng.integers(1, 31, n)).astype(np.int32)
+        rf_codes = np.where(
+            rdate <= _d(1995, 6, 17),
+            np.where(rng.random(n) < 0.5, 0, 1),
+            2,
+        )
+        ls_codes = np.where(sdate > _d(1995, 6, 17), 0, 1)
+        yield {
+            "l_orderkey": lok,
+            "l_partkey": pk,
+            "l_suppkey": sk,
+            "l_linenumber": linenumber,
+            "l_quantity": qty,
+            "l_extendedprice": eprice,
+            "l_discount": np.round(rng.integers(0, 11, n) / 100.0, 2),
+            "l_tax": np.round(rng.integers(0, 9, n) / 100.0, 2),
+            "l_returnflag": _dict_col(rf_codes, ["R", "A", "N"]),
+            "l_linestatus": _dict_col(ls_codes, ["O", "F"]),
+            "l_shipdate": _date_col(sdate),
+            "l_commitdate": _date_col(cdate),
+            "l_receiptdate": _date_col(rdate),
+            "l_shipinstruct": _dict_col(
+                rng.integers(0, 4, n), SHIPINSTRUCT
+            ),
+            "l_shipmode": _dict_col(rng.integers(0, 7, n), SHIPMODES),
+            "l_comment": _dict_col(
+                rng.integers(0, len(_COMMENT_VOCAB), n), _COMMENT_VOCAB
+            ),
+        }
+
+
+def gen_customer_chunks(scale: float, seed: int, chunk_rows: int):
+    n = max(1, int(_CARD["customer"] * scale))
+    for start in range(0, n, chunk_rows):
+        m = min(chunk_rows, n - start)
+        rng = _rng(seed, "customer", start)
+        keys = np.arange(start + 1, start + m + 1, dtype=np.int64)
+        nk = rng.integers(0, len(NATIONS), m).astype(np.int64)
+        yield {
+            "c_custkey": keys,
+            "c_name": pa.array([f"Customer#{k:09d}" for k in keys]),
+            "c_address": _dict_col(
+                rng.integers(0, len(_COMMENT_VOCAB), m), _COMMENT_VOCAB
+            ),
+            "c_nationkey": nk,
+            "c_phone": _dict_col(
+                rng.integers(0, len(_PHONE_VOCAB), m), _PHONE_VOCAB
+            ),
+            "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, m), 2),
+            "c_mktsegment": _dict_col(rng.integers(0, 5, m), SEGMENTS),
+            "c_comment": _dict_col(
+                rng.integers(0, len(_COMMENT_VOCAB), m), _COMMENT_VOCAB
+            ),
+        }
+
+
+def gen_supplier_chunks(scale: float, seed: int, chunk_rows: int):
+    n = max(1, int(_CARD["supplier"] * scale))
+    for start in range(0, n, chunk_rows):
+        m = min(chunk_rows, n - start)
+        rng = _rng(seed, "supplier", start)
+        keys = np.arange(start + 1, start + m + 1, dtype=np.int64)
+        nk = rng.integers(0, len(NATIONS), m).astype(np.int64)
+        yield {
+            "s_suppkey": keys,
+            "s_name": pa.array([f"Supplier#{k:09d}" for k in keys]),
+            "s_address": _dict_col(
+                rng.integers(0, len(_COMMENT_VOCAB), m), _COMMENT_VOCAB
+            ),
+            "s_nationkey": nk,
+            "s_phone": _dict_col(
+                rng.integers(0, len(_PHONE_VOCAB), m), _PHONE_VOCAB
+            ),
+            "s_acctbal": np.round(rng.uniform(-999.99, 9999.99, m), 2),
+            "s_comment": _dict_col(
+                rng.integers(0, len(_COMMENT_VOCAB), m), _COMMENT_VOCAB
+            ),
+        }
+
+
+_CHUNKED = {
+    "orders": gen_orders_chunks,
+    "lineitem": gen_lineitem_chunks,
+    "customer": gen_customer_chunks,
+    "supplier": gen_supplier_chunks,
+}
+
+DEFAULT_TABLES = "lineitem,orders,customer,supplier,nation,region"
+
+
+def write_table(
+    table: str,
+    scale: float,
+    out_dir: pathlib.Path,
+    seed: int = 42,
+    chunk_rows: int = 4_000_000,
+    row_group: int = 2_000_000,
+) -> dict:
+    path = out_dir / f"{table}.parquet"
+    t0 = time.time()
+    if table in _CHUNKED:
+        w = _Writer(path, table, row_group)
+        for cols in _CHUNKED[table](scale, seed, chunk_rows):
+            w.write(cols)
+        w.close()
+        rows = w.rows
+    else:
+        t = gen_table(table, scale, seed)
+        papq.write_table(
+            t.cast(_arrow_schema(table)),
+            str(path),
+            row_group_size=row_group,
+            compression="snappy",
+        )
+        rows = t.num_rows
+    return {
+        "rows": rows,
+        "seconds": round(time.time() - t0, 1),
+        "bytes": path.stat().st_size,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, required=True)
+    ap.add_argument("--path", required=True)
+    ap.add_argument("--tables", default=DEFAULT_TABLES)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--chunk-rows", type=int, default=4_000_000)
+    ap.add_argument("--row-group", type=int, default=2_000_000)
+    args = ap.parse_args()
+    out = pathlib.Path(args.path)
+    out.mkdir(parents=True, exist_ok=True)
+    manifest = {"scale": args.scale, "seed": args.seed, "tables": {}}
+    for table in args.tables.split(","):
+        table = table.strip()
+        info = write_table(
+            table, args.scale, out, args.seed, args.chunk_rows,
+            args.row_group,
+        )
+        manifest["tables"][table] = info
+        print(f"{table}: {info}", flush=True)
+    (out / "manifest.json").write_text(json.dumps(manifest, indent=2))
+
+
+if __name__ == "__main__":
+    main()

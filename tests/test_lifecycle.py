@@ -186,6 +186,21 @@ print("ENTRYPOINT-OK")
     # have their stage bookkeeping torn down, so it may be empty)
     assert all("stages" in j for j in state["jobs"]), state
 
+    assert state["executors"][0]["n_devices"] == 8  # virtual mesh advertised
+
+    # /api/job/<id>: stage DAG detail (deps + plan display) for the UI's
+    # expandable job rows
+    done = [j for j in state["jobs"] if j["status"] == "completed"]
+    detail = json.loads(
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{rest_port}/api/job/{done[0]['job_id']}",
+            timeout=10,
+        ).read()
+    )
+    assert detail["status"] == "completed"
+    assert detail["stages"], detail
+    assert all("plan" in s and "depends_on" in s for s in detail["stages"])
+
     # the UI page serves
     page = urllib.request.urlopen(
         f"http://127.0.0.1:{rest_port}/", timeout=10

@@ -197,3 +197,104 @@ np.testing.assert_allclose(got2.v.values, want2.v.values, rtol=1e-12)
 print("MESH-TOPK-OK")
 """)
     assert "MESH-TOPK-OK" in out
+
+
+def test_sql_full_order_by_runs_as_mesh_sample_sort():
+    # VERDICT r4 weak#6: no-LIMIT ORDER BY used to funnel through
+    # CoalescePartitions to one device; now a sample sort (splitters ->
+    # range all_to_all -> local sort) keeps it on the mesh.
+    out = run_script(r"""
+import pandas as pd
+n = 5000
+t = pa.table({"k": rng.integers(0, 40, n),
+              "g": rng.integers(0, 7, n),
+              "v": np.round(rng.uniform(-100, 100, n), 2)})
+ctx.register_table("t", t)
+sql = "SELECT k, g, v FROM t ORDER BY v DESC, k ASC, g ASC"
+disp = physical_display(sql)
+assert "MeshSortExec(ici-sample-sort)" in disp, disp
+assert "CoalescePartitionsExec" not in disp, disp
+res = ctx.sql(sql).collect().to_pandas().reset_index(drop=True)
+exp = (t.to_pandas()
+        .sort_values(["v", "k", "g"], ascending=[False, True, True])
+        .reset_index(drop=True)[["k", "g", "v"]])
+pd.testing.assert_frame_equal(res, exp)
+print("MESH-SAMPLE-SORT-OK")
+""")
+    assert "MESH-SAMPLE-SORT-OK" in out
+
+
+def test_sql_ranking_window_runs_on_mesh():
+    out = run_script(r"""
+import pandas as pd
+n = 5000
+t = pa.table({"k": rng.integers(0, 40, n),
+              "g": rng.integers(0, 7, n),
+              "v": np.round(rng.uniform(-100, 100, n), 2)})
+ctx.register_table("t", t)
+sql = ("SELECT k, g, v, "
+       "row_number() OVER (PARTITION BY g ORDER BY v DESC) AS rn, "
+       "rank() OVER (PARTITION BY g ORDER BY v DESC) AS rk FROM t")
+disp = physical_display(sql)
+assert "MeshWindowExec" in disp, disp
+res = (ctx.sql(sql).collect().to_pandas()
+       .sort_values(["g", "v", "k", "rn"]).reset_index(drop=True))
+df = t.to_pandas()
+df["rn"] = df.groupby("g")["v"].rank(
+    method="first", ascending=False).astype("int64")
+df["rk"] = df.groupby("g")["v"].rank(
+    method="min", ascending=False).astype("int64")
+exp = (df.sort_values(["g", "v", "k", "rn"]).reset_index(drop=True)
+         [["k", "g", "v", "rn", "rk"]])
+# rank is deterministic; row_number's order within peer ties is not —
+# compare it as a multiset
+pd.testing.assert_frame_equal(res[["k", "g", "v", "rk"]],
+                              exp[["k", "g", "v", "rk"]])
+assert sorted(res["rn"]) == sorted(exp["rn"])
+print("MESH-WINDOW-RANK-OK")
+""")
+    assert "MESH-WINDOW-RANK-OK" in out
+
+
+def test_sql_frame_window_runs_on_mesh():
+    out = run_script(r"""
+import pandas as pd
+n = 5000
+t = pa.table({"k": rng.integers(0, 40, n),
+              "g": rng.integers(0, 7, n),
+              "v": np.round(rng.uniform(-100, 100, n), 2)})
+ctx.register_table("t", t)
+sql = ("SELECT k, g, v, SUM(v) OVER (PARTITION BY g ORDER BY v "
+       "ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS cs FROM t")
+disp = physical_display(sql)
+assert "MeshWindowExec" in disp, disp
+res = (ctx.sql(sql).collect().to_pandas()
+       .sort_values(["g", "v", "k"]).reset_index(drop=True))
+df2 = t.to_pandas().sort_values(["g", "v"], kind="stable")
+df2["cs"] = df2.groupby("g")["v"].cumsum()
+exp = (df2.sort_values(["g", "v", "k"]).reset_index(drop=True)
+          [["k", "g", "v", "cs"]])
+# cumsum order within v-ties is arbitrary; the running sum at each peer
+# group's END row is deterministic — compare those
+m = res.groupby(["g", "v"])["cs"].max().reset_index()
+me = exp.groupby(["g", "v"])["cs"].max().reset_index()
+pd.testing.assert_frame_equal(m, me, check_exact=False, rtol=1e-9)
+print("MESH-WINDOW-FRAME-OK")
+""")
+    assert "MESH-WINDOW-FRAME-OK" in out
+
+
+def test_sql_window_without_partition_falls_back_local():
+    out = run_script(r"""
+n = 400
+t = pa.table({"v": np.round(rng.uniform(-10, 10, n), 2)})
+ctx.register_table("t", t)
+sql = "SELECT v, row_number() OVER (ORDER BY v) AS rn FROM t"
+disp = physical_display(sql)
+assert "MeshWindowExec" not in disp, disp
+assert "WindowExec" in disp, disp
+got = ctx.sql(sql).collect().to_pandas().sort_values("rn")
+assert (got.v.values == np.sort(t.to_pandas().v.values)).all()
+print("MESH-WINDOW-FALLBACK-OK")
+""")
+    assert "MESH-WINDOW-FALLBACK-OK" in out
