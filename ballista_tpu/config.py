@@ -41,6 +41,10 @@ BALLISTA_JOIN_EXPANSION = "ballista.tpu.join_expansion"  # probe-output expansio
 BALLISTA_BUILD_CACHE_MB = "ballista.tpu.build_cache_mb"  # join build-table HBM cache
 BALLISTA_COLLECTIVE_SHUFFLE = "ballista.tpu.collective_shuffle"  # on-pod all_to_all
 BALLISTA_SCAN_STREAM_MB = "ballista.tpu.scan_stream_mb"  # parquet streaming threshold
+BALLISTA_HBM_BUDGET_MB = "ballista.tpu.hbm_budget_mb"  # grace-hash trigger
+BALLISTA_SPILL_BUDGET_MB = "ballista.tpu.spill_budget_mb"  # host spill ceiling
+BALLISTA_SPILL_DIR = "ballista.tpu.spill_dir"  # grace-hash spill location
+BALLISTA_PREFETCH_DEPTH = "ballista.tpu.prefetch_depth"  # streamed-scan overlap
 
 
 class TaskSchedulingPolicy(Enum):
@@ -177,6 +181,45 @@ def _entries() -> dict[str, ConfigEntry]:
             "4096",
             int,
         ),
+        ConfigEntry(
+            BALLISTA_HBM_BUDGET_MB,
+            "Device-memory budget (MB) an operator's resident working set "
+            "may use before it switches to grace-hash partitioned passes: "
+            "a join build side or a final-aggregate state set larger than "
+            "this is hash-split into K ranges, spilled to host Arrow IPC "
+            "files, and processed range-by-range through the same kernels "
+            "(docs/memory.md). 0 disables — every pipeline must then fit "
+            "in HBM at once.",
+            "0",
+            int,
+        ),
+        ConfigEntry(
+            BALLISTA_SPILL_BUDGET_MB,
+            "Host-disk budget (MB) for grace-hash spill files per task "
+            "attempt; exceeding it fails the task rather than filling the "
+            "disk. 0 = unlimited.",
+            str(1 << 16),
+            int,
+        ),
+        ConfigEntry(
+            BALLISTA_SPILL_DIR,
+            "Directory for grace-hash spill files. Empty = the task's "
+            "work_dir (distributed executors — files then share the "
+            "shuffle TTL sweep) or the system temp dir (local contexts).",
+            "",
+            str,
+        ),
+        ConfigEntry(
+            BALLISTA_PREFETCH_DEPTH,
+            "Row-group slices a streamed parquet scan reads/converts and "
+            "stages ahead of the slice currently computing (a background "
+            "host thread overlaps parquet decode + host->device transfer "
+            "with device time). 0 disables the overlap; 1 (double "
+            "buffering) is usually enough to hide decode on scan-bound "
+            "queries.",
+            "1",
+            int,
+        ),
     ]
     return {e.name: e for e in ents}
 
@@ -273,6 +316,18 @@ class BallistaConfig:
 
     def scan_stream_mb(self) -> int:
         return self._get(BALLISTA_SCAN_STREAM_MB)
+
+    def hbm_budget_mb(self) -> int:
+        return self._get(BALLISTA_HBM_BUDGET_MB)
+
+    def spill_budget_mb(self) -> int:
+        return self._get(BALLISTA_SPILL_BUDGET_MB)
+
+    def spill_dir(self) -> str:
+        return self._get(BALLISTA_SPILL_DIR)
+
+    def prefetch_depth(self) -> int:
+        return self._get(BALLISTA_PREFETCH_DEPTH)
 
     def collective_shuffle(self) -> bool:
         return self._get(BALLISTA_COLLECTIVE_SHUFFLE)

@@ -1155,10 +1155,26 @@ class HashAggregateExec(ExecutionPlan):
         # merge ONLY this output partition's input partition: the planner
         # guarantees the input is either a 1-partition coalesce (funnel) or
         # a hash repartition on the group keys (K parallel merges)
-        states = list(self.input.execute(partition, ctx))
+        merge_ops = [s.op.merge_op for s in self.spec.slots]
+        budget = ctx.config.hbm_budget_mb() << 20
+        if budget and n_groups > 0:
+            # incremental collection: the moment the running state total
+            # crosses the budget, already-resident states drain to host
+            # buckets and the rest of the stream follows — the set is
+            # never fully device-resident (a list() here would OOM before
+            # any budget check could run)
+            states, grace = self._collect_states_grace(
+                partition, ctx, budget, n_groups
+            )
+            if grace is not None:
+                yield from self._grace_merge(
+                    grace, ctx, cap, n_groups, merge_ops, budget
+                )
+                return
+        else:
+            states = list(self.input.execute(partition, ctx))
         if not states:
             return
-        merge_ops = [s.op.merge_op for s in self.spec.slots]
         if n_groups == 0:
             # one jitted program for merge-concat + scalar merge + final
             # (eagerly this is ~15 separate dispatches — each a round
@@ -1294,6 +1310,100 @@ class HashAggregateExec(ExecutionPlan):
                 site=site,
             )
         yield self._finalize(state, n_groups)
+
+    # Bucket fan-out of the spill files; K passes (a power of two dividing
+    # this, chosen once the true state total is known) group consecutive
+    # buckets — (h % 64) % K == h % K for K | 64, so the routing written
+    # before K was known stays aligned at any K.
+    _GRACE_BUCKETS = 64
+
+    def _collect_states_grace(
+        self, partition: int, ctx: TaskContext, budget: int, n_groups: int
+    ) -> tuple:
+        """Collect this partition's partial states under the HBM budget.
+        Returns (states, None) when they all fit resident, else
+        (None, (spill set, total bytes)) with every state hash-spilled by
+        group key to host bucket files — the drain-then-spill switch fires
+        the moment the running total crosses the budget, so the full set
+        is never device-resident. A LONE over-budget state never spills:
+        it was already materialized by the child, and the single-state
+        finalize shortcuts need it resident anyway."""
+        from ballista_tpu.exec.spill import device_nbytes, spill_batch_by_keys
+
+        key_idxs = tuple(range(n_groups))
+        states: list[DeviceBatch] = []
+        total = 0
+        sset = None
+        spilled = 0
+        for st in self.input.execute(partition, ctx):
+            total += device_nbytes(st)
+            if sset is None and states and total > budget:
+                sset = ctx.spill_manager().new_set(
+                    f"agg-{id(self):x}-{partition}", self._GRACE_BUCKETS
+                )
+                with self.metrics.time("spill_time"):
+                    for prev in states:
+                        spilled += spill_batch_by_keys(sset, prev, key_idxs)
+                states.clear()
+            if sset is None:
+                states.append(st)
+            else:
+                with self.metrics.time("spill_time"):
+                    spilled += spill_batch_by_keys(sset, st, key_idxs)
+        if sset is None:
+            return states, None
+        sset.finish_writes()
+        self.metrics.add("spill_bytes", spilled)
+        return None, (sset, total)
+
+    def _grace_merge(
+        self,
+        grace: tuple,
+        ctx: TaskContext,
+        cap: int,
+        n_groups: int,
+        merge_ops: list,
+        budget_bytes: int,
+    ) -> Iterator[DeviceBatch]:
+        """Out-of-core final merge (grace hash): the partial states were
+        hash-spilled by group key to host Arrow IPC buckets (the shuffle
+        partitioner's routing rule, so strings route by value and NULL
+        keys share a bucket — _collect_states_grace); re-load and merge
+        one bucket range at a time through the ordinary merge kernel.
+        Each range's merged state finalizes independently — group keys
+        are unique ACROSS buckets by the hash split, so the concatenated
+        outputs are exactly the in-memory result."""
+        from ballista_tpu.columnar.arrow_interop import table_from_arrow
+        from ballista_tpu.exec.spill import choose_passes
+
+        sset, total_bytes = grace
+        k = choose_passes(total_bytes, budget_bytes, self._GRACE_BUCKETS)
+        self.metrics.add("spill_passes", k)
+        group = self._GRACE_BUCKETS // k
+        batch_rows = ctx.config.tpu_batch_rows()
+        site = self.display() + "|grace"
+        for pass_i in range(k):
+            tabs = [
+                t
+                for b in range(pass_i * group, (pass_i + 1) * group)
+                if (t := sset.read(b)) is not None and t.num_rows
+            ]
+            if not tabs:
+                continue
+            # narrowing OFF: every bucket must share one physical layout
+            # (a per-bucket int32/int64 decision would recompile the merge
+            # program per bucket)
+            bucket: list[DeviceBatch] = []
+            for t in tabs:
+                bucket.extend(table_from_arrow(t, batch_rows, frozenset()))
+            merged = concat_batches(bucket) if len(bucket) > 1 else bucket[0]
+            with self.metrics.time("merge_time"):
+                state = self._run_group_agg(
+                    merged, merge_ops, n_groups, cap, from_state=True,
+                    ctx=ctx, site=site,
+                )
+            yield self._finalize(state, n_groups)
+        sset.close()
 
     def _slice_states(
         self,

@@ -72,6 +72,35 @@ class TaskContext:
     # a fresh plan per task) must turn it off, or the shared HBM tally
     # counts entries that die with the task and admission starves.
     cache_builds: bool = True
+    # Lazily-created grace-hash spill manager (exec/spill.py); owned by the
+    # attempt — run_with_capacity_retry closes it (deleting the files) at
+    # every attempt boundary, so retries never see stale buckets.
+    spill: object | None = None
+
+    def spill_manager(self):
+        """The attempt's SpillManager, created on first spill. Files land
+        under the executor work_dir (shuffle-TTL-swept if the process
+        dies) or the shared temp spill root for local contexts; an
+        explicit ballista.tpu.spill_dir overrides both."""
+        if self.spill is None:
+            import os
+
+            from ballista_tpu.exec.spill import SpillManager
+
+            base = self.config.spill_dir() or None
+            if base is None and self.work_dir:
+                base = os.path.join(
+                    self.work_dir, self.job_id or "local", "spill"
+                )
+            self.spill = SpillManager(
+                base, self.config.spill_budget_mb() << 20
+            )
+        return self.spill
+
+    def close_spills(self) -> None:
+        if self.spill is not None:
+            self.spill.close()
+            self.spill = None
 
     def _start_async_copy(self, *values) -> None:
         """Start a device->host copy of each scalar NOW so raise_deferred's
@@ -357,6 +386,12 @@ def run_with_capacity_retry(
                     raise
                 continue
             raise
+        finally:
+            # grace-hash spill files are attempt-scoped: every exit from
+            # an attempt (success, retry, failure) deletes them so a retry
+            # never reads a previous attempt's buckets and a long-lived
+            # executor never accretes spill data
+            ctx.close_spills()
 
 
 class Metrics:
@@ -385,6 +420,25 @@ class Metrics:
         }
         out.update({k: round(v, 6) for k, v in self.timers.items()})
         return out
+
+
+def plan_counters(plan, names) -> dict[str, int]:
+    """Sum the named metric counters over a whole plan tree — the most
+    recent run's values (collect resets per-operator metrics per query).
+    The out-of-core/prefetch reporting surface of bench.py and the
+    out-of-core tests, via DataFrame.collect_with_plan."""
+    out = {n: 0 for n in names}
+
+    def walk(p) -> None:
+        for n in names:
+            v = p.metrics.counters.get(n)
+            if v is not None:
+                out[n] += int(v)
+        for c in p.children():
+            walk(c)
+
+    walk(plan)
+    return out
 
 
 class _Timer:

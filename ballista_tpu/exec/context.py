@@ -545,8 +545,17 @@ class DataFrame:
         return cls.from_arrow(ctx, pa.table({"result": pa.array(["ok"])}))
 
     def collect(self) -> pa.Table:
+        return self.collect_with_plan()[0]
+
+    def collect_with_plan(self) -> tuple:
+        """(table, executed physical plan). The plan handle lets callers
+        read per-operator metrics of THIS run (spill bytes/passes,
+        prefetch hits) after it completes — re-calling
+        create_physical_plan would hand back a fresh tree with reset
+        metrics. bench.py and the out-of-core tests consume this; plain
+        collect() is the (table-only) user surface."""
         if self._const is not None:
-            return self._const
+            return self._const, None
         phys = self.ctx.create_physical_plan(self.logical)
         part = phys.output_partitioning()
         n = part.n if isinstance(part, UnknownPartitioning) else part.n
@@ -578,8 +587,8 @@ class DataFrame:
                         phys.schema(), schema_to_arrow(phys.schema())
                     )
                 }
-            )
-        return pa.Table.from_batches(record_batches)
+            ), phys
+        return pa.Table.from_batches(record_batches), phys
 
     def to_pandas(self):
         return self.collect().to_pandas()

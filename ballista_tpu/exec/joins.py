@@ -254,15 +254,57 @@ class HashJoinExec(ExecutionPlan):
             )
             return
 
-        if self.join_type == JoinType.INNER:
-            yield from self._execute_inner(partition, ctx, left_keys, right_keys)
-            return
-
-        # LEFT/SEMI/ANTI: left side is preserved => left probes, right builds.
-        yield from self._probe_loop(
-            partition, ctx, lambda: _collect(self.right, ctx),
-            left_keys, right_keys, self._KIND[self.join_type],
+        learned = (
+            self._learned_flip(ctx, left_keys, right_keys)
+            if self.join_type == JoinType.INNER
+            else None
         )
+        budget = ctx.config.hbm_budget_mb() << 20
+        if (
+            budget
+            and learned is None
+            and not any(
+                s in self._build_cache
+                for s in (("bt_probe", None), ("bt_right",), ("bt_flip",))
+            )
+        ):
+            # Skip the grace-budget probe when a warm path already proved
+            # the budget moot: a LEARNED flip strategy builds the (unique,
+            # small) LEFT side and streams the right — probing would
+            # collect or spill the full right subtree, the exact cost that
+            # path exists to avoid; and a cross-run cached build table
+            # means the side fit in HBM and was admitted — re-executing
+            # its subtree (q18's HAVING aggregate) would forfeit the
+            # build-cache speedup and strand the collected batch in the
+            # never-consumed stash.
+            grace = self._grace_build(ctx, right_keys, budget)
+            if grace is not None:
+                yield from self._execute_grace(
+                    partition, ctx, grace, left_keys, right_keys
+                )
+                return
+
+        try:
+            if self.join_type == JoinType.INNER:
+                yield from self._execute_inner(
+                    partition, ctx, left_keys, right_keys, learned
+                )
+                return
+
+            # LEFT/SEMI/ANTI: left is preserved => left probes, right builds.
+            yield from self._probe_loop(
+                partition, ctx, lambda: self._collect_right(ctx),
+                left_keys, right_keys, self._KIND[self.join_type],
+            )
+        finally:
+            # Drop an unconsumed grace-probe stash on EVERY exit — empty
+            # probe side, a downstream exception, an abandoned generator
+            # (LIMIT) — or the collected build side stays pinned in HBM on
+            # this plan instance, which outlives the run in the
+            # cross-query physical-plan cache.
+            c = getattr(self, "_grace_under", None)
+            if c is not None and c[0] is ctx:
+                self._grace_under = None
 
     _KIND = {
         JoinType.INNER: JoinSide.INNER,
@@ -282,6 +324,214 @@ class HashJoinExec(ExecutionPlan):
             partition, ctx,
             lambda: _collect_partition(self.right, ctx, partition),
             left_keys, right_keys, self._KIND[self.join_type],
+        )
+
+    # -- grace-hash out-of-core path ------------------------------------------
+    # Bucket fan-out of the spill files. Passes K (a power of two dividing
+    # this) group consecutive buckets, so K is chosen AFTER the build side's
+    # true size is known without re-spilling: (h % 64) % K == h % K for
+    # K | 64, keeping build and probe routing aligned at any K.
+    _GRACE_BUCKETS = 64
+
+    def _collect_right(self, ctx: TaskContext) -> DeviceBatch:
+        """The collected build side; reuses the batch the grace-budget
+        probe collected when it decided the side fits in HBM (avoiding a
+        second full execution of the build subtree). One-shot: the stash
+        is dropped on consumption so the plan instance never pins the
+        collected side in HBM past the caller's own reference — the
+        flip-streaming INNER path frees its local refs before streaming
+        specifically to avoid holding a fact-sized batch."""
+        c = getattr(self, "_grace_under", None)
+        if c is not None and c[0] is ctx:
+            self._grace_under = None
+            return c[1]
+        return _collect(self.right, ctx)
+
+    def _grace_build(self, ctx: TaskContext, right_keys, budget: int):
+        """Collect the build side under the HBM budget. Returns None when
+        it fits (stashing the collected batch for the normal paths), else
+        (spill set, K passes): batches collected so far plus the rest of
+        the stream are hash-routed to host bucket files and the join runs
+        bucket-range by bucket-range (_execute_grace). Decided once per
+        task context — every probe partition shares the spilled build."""
+        cached = getattr(self, "_grace_cache", None)
+        if cached is not None and cached[0] is ctx:
+            return cached[1]
+        from ballista_tpu.exec.spill import (
+            choose_passes,
+            device_nbytes,
+            spill_batch_by_keys,
+        )
+
+        keys = tuple(right_keys)
+        batches: list[DeviceBatch] = []
+        nbytes = 0
+        sset = None
+        spilled = 0
+        part = self.right.output_partitioning()
+        with self.metrics.time("build_time"):
+            for p in range(part.n):
+                for b in self.right.execute(p, ctx):
+                    nbytes += device_nbytes(b)
+                    if sset is None and nbytes * 2 > budget:
+                        # crossed the budget (build tables cost ~2x the
+                        # raw side: sorted copy + key arrays): switch to
+                        # spilling, draining what is already resident
+                        sset = ctx.spill_manager().new_set(
+                            f"join-build-{id(self):x}", self._GRACE_BUCKETS
+                        )
+                        for prev in batches:
+                            spilled += spill_batch_by_keys(sset, prev, keys)
+                        batches.clear()
+                    if sset is None:
+                        batches.append(b)
+                    else:
+                        spilled += spill_batch_by_keys(sset, b, keys)
+        if sset is None:
+            build = (
+                concat_batches(batches)
+                if batches
+                else DeviceBatch.empty(self.right.schema())
+            )
+            self._grace_under = (ctx, build)
+            self._grace_cache = (ctx, None)
+            return None
+        sset.finish_writes()
+        self.metrics.add("spill_bytes", spilled)
+        k = choose_passes(nbytes, budget, self._GRACE_BUCKETS)
+        # recorded once per grace DECISION, not per probe partition —
+        # plan_counters sums operator counters, and a per-partition add
+        # would report k x partitions for a k-pass join
+        self.metrics.add("spill_passes", k)
+        self._grace_cache = (ctx, (sset, k))
+        return (sset, k)
+
+    def _execute_grace(
+        self, partition, ctx, grace, left_keys, right_keys
+    ) -> Iterator[DeviceBatch]:
+        """Grace-hash join: both sides are hash-routed to aligned host
+        bucket files; each pass loads one bucket range's build side,
+        builds it with the ordinary kernels, and streams that range's
+        probe rows through the ordinary probe/expansion. Equal keys share
+        a bucket by the hash split, so the concatenated pass outputs are
+        exactly the one-shot join for every supported join type (the
+        preserved side of LEFT/SEMI/ANTI appears in exactly one bucket)."""
+        from ballista_tpu.columnar.arrow_interop import table_from_arrow
+        from ballista_tpu.exec.shrink import maybe_shrink
+        from ballista_tpu.exec.spill import (
+            spill_batch_by_keys,
+            tables_string_dicts,
+        )
+
+        sset, k = grace
+        kind = self._KIND[self.join_type]
+        pset = ctx.spill_manager().new_set(
+            f"join-probe-{id(self):x}-{partition}", self._GRACE_BUCKETS
+        )
+        spilled = 0
+        with self.metrics.time("spill_time"):
+            for b in self.left.execute(partition, ctx):
+                spilled += spill_batch_by_keys(pset, b, tuple(left_keys))
+        pset.finish_writes()
+        self.metrics.add("spill_bytes", spilled)
+        batch_rows = ctx.config.tpu_batch_rows()
+        group = self._GRACE_BUCKETS // k
+        site = self.display() + "|grace"
+        for pass_i in range(k):
+            buckets = range(pass_i * group, (pass_i + 1) * group)
+            ptabs = [
+                t
+                for bk in buckets
+                if (t := pset.read(bk)) is not None and t.num_rows
+            ]
+            if not ptabs:
+                continue  # no probe rows: nothing to emit for any kind
+
+            # one union dictionary set for the pass so every probe chunk
+            # shares codes — per-chunk dictionaries would make
+            # _unify_key_dicts rebuild (re-sort) the build side per chunk
+            pass_dicts = tables_string_dicts(ptabs)
+
+            def probe_batches(ptabs=ptabs, pass_dicts=pass_dicts):
+                # convert lazily, one batch_rows chunk at a time: K bounds
+                # the BUILD side's residency, not the probe side's, so a
+                # probe-heavy range must stream through device memory
+                # batch by batch rather than materialize whole. narrowing
+                # OFF on BOTH sides: probe and build key columns must
+                # share one physical width within a pass.
+                for t in ptabs:
+                    for off in range(0, t.num_rows, batch_rows):
+                        yield from table_from_arrow(
+                            t.slice(off, batch_rows), batch_rows,
+                            frozenset(), fixed_dicts=pass_dicts,
+                        )
+
+            btabs = [
+                t
+                for bk in buckets
+                if (t := sset.read(bk)) is not None and t.num_rows
+            ]
+            if not btabs:
+                # build side empty for this range: INNER/SEMI emit nothing,
+                # ANTI preserves every probe row, LEFT preserves with a
+                # nulled build side
+                if kind in (JoinSide.INNER, JoinSide.SEMI):
+                    continue
+                for pb in probe_batches():
+                    yield (
+                        pb
+                        if kind == JoinSide.ANTI
+                        else self._null_extend(pb)
+                    )
+                continue
+            with self.metrics.time("build_time"):
+                bb_parts: list[DeviceBatch] = []
+                for t in btabs:
+                    bb_parts.extend(
+                        table_from_arrow(t, 1 << 62, frozenset())
+                    )
+                bb = (
+                    concat_batches(bb_parts)
+                    if len(bb_parts) > 1
+                    else bb_parts[0]
+                )
+                bt = build_side(bb, right_keys)
+            for pb in probe_batches():
+                bb2, pb2 = self._unify_key_dicts(
+                    bb, pb, right_keys, left_keys
+                )
+                if bb2 is not bb:
+                    with self.metrics.time("build_time"):
+                        bt = build_side(bb2, right_keys)
+                    bb = bb2
+                out = self._probe_or_expand(
+                    bt, pb2, left_keys, kind, ctx, None, partition
+                )
+                if kind in (JoinSide.INNER, JoinSide.LEFT):
+                    out = self._restore_column_order(out, pb2, bt.batch, True)
+                self.metrics.add("output_batches")
+                yield maybe_shrink(out, ctx, site, partition)
+        pset.close()
+
+    def _null_extend(self, pb: DeviceBatch) -> DeviceBatch:
+        """LEFT-join rows for an empty build range: probe columns pass
+        through, build columns are all-null."""
+        from ballista_tpu.columnar.batch import Dictionary
+
+        cols = list(pb.columns)
+        nulls = list(pb.nulls)
+        dicts = dict(pb.dictionaries)
+        for f in self.right.schema():
+            cols.append(jnp.zeros(pb.capacity, dtype=f.dtype.to_np()))
+            nulls.append(jnp.ones(pb.capacity, dtype=bool))
+            if f.dtype == DataType.STRING:
+                dicts[f.name] = Dictionary(())
+        return DeviceBatch(
+            schema=self._schema,
+            columns=tuple(cols),
+            valid=pb.valid,
+            nulls=tuple(nulls),
+            dictionaries=dicts,
         )
 
     def _probe_loop(
@@ -326,19 +576,42 @@ class HashJoinExec(ExecutionPlan):
                 site = self.display()
             yield maybe_shrink(out, ctx, site, partition)
 
+    def _learned_flip(self, ctx, left_keys, right_keys):
+        """(left strategy key, left flags) when the plan cache holds a
+        LEARNED flip-streaming INNER strategy — right side can't serve as
+        a unique build (dups/overflow) but the left can, with int keys
+        (no dictionary unification, so the collected right would be
+        decision input only). None otherwise. Consulted BEFORE the
+        grace-budget probe in execute(): that probe collects (or spills)
+        the whole right subtree, the exact cost the flip path avoids."""
+        cache = ctx.plan_cache
+        if cache is None:
+            return None
+        ls, rs = self.left.schema(), self.right.schema()
+        if any(
+            ls.fields[i].dtype == DataType.STRING for i in left_keys
+        ) or any(rs.fields[i].dtype == DataType.STRING for i in right_keys):
+            return None
+        rflags = cache.get(self._strategy_key(self.right, right_keys, ctx))
+        if rflags is None or not (rflags[0] or rflags[1]):
+            return None
+        lfp = self._strategy_key(self.left, left_keys, ctx)
+        lflags = cache.get(lfp)
+        if lflags is None or lflags[0] or lflags[1]:
+            return None
+        return lfp, lflags
+
     def _execute_inner(
-        self, partition, ctx, left_keys, right_keys
+        self, partition, ctx, left_keys, right_keys, learned
     ) -> Iterator[DeviceBatch]:
         """INNER: build the right side. If it has duplicate keys, prefer
         flipping to build a unique left side (fixed-capacity probe, no
         expansion); if BOTH sides have duplicates, run the m:n expansion
-        join with the right side as build."""
+        join with the right side as build. ``learned`` is execute()'s
+        _learned_flip result (computed once — each probe renders both
+        subtrees' display strings for the plan-cache keys)."""
         ls, rs = self.left.schema(), self.right.schema()
-        cache0 = ctx.plan_cache
-        key_strings = any(
-            ls.fields[i].dtype == DataType.STRING for i in left_keys
-        ) or any(rs.fields[i].dtype == DataType.STRING for i in right_keys)
-        if cache0 is not None and not key_strings:
+        if learned is not None:
             # Cached-flip fast path: when prior runs LEARNED that the
             # right side cannot serve as a unique build (dups/overflow)
             # and the left CAN, skip collecting the right entirely —
@@ -350,67 +623,58 @@ class HashJoinExec(ExecutionPlan):
             # the general path); the right's "has dups" bit needs NO
             # validation — a unique-left build probe is correct whether
             # or not the probe side has duplicates.
-            rflags = cache0.get(self._strategy_key(self.right, right_keys, ctx))
-            lfp = self._strategy_key(self.left, left_keys, ctx)
-            lflags = cache0.get(lfp)
-            if (
-                rflags is not None
-                and (rflags[0] or rflags[1])
-                and lflags is not None
-                and not lflags[0]
-                and not lflags[1]
-            ):
-                if partition != 0:
-                    return
-                from ballista_tpu.exec.shrink import maybe_shrink
-
-                cached = self._build_cache.get(("bt_flip",))
-                if cached is not None:
-                    left_batch, lbt = cached
-                else:
-                    with self.metrics.time("build_time"):
-                        left_batch = _collect(self.left, ctx)
-                        lbt = build_side(left_batch, left_keys)
-                    self._build_cache_put(
-                        ctx, ("bt_flip",), left_batch, lbt, left_keys
-                    )
-                ctx.defer_speculation(
-                    lbt.spec_flag(),
-                    "cached join build strategy went stale (flip side "
-                    "no longer unique)",
-                    [lfp, ("join_lut", lfp)],
-                )
-                contig = self._contig_probe(lbt, lflags, True, ctx, lfp)
-                site = self.display()
-                rpart = self.right.output_partitioning()
-                for p in range(rpart.n):
-                    for b in self.right.execute(p, ctx):
-                        if not contig:
-                            # per-batch: the general path gates the LUT
-                            # on the COLLECTED probe capacity, which the
-                            # stream never materializes — re-offering
-                            # each batch converges to the same decision
-                            # (the helper early-outs once attached or
-                            # once the domain is learned unusable)
-                            self._maybe_attach_lut(
-                                lbt, b.capacity, ctx, lfp
-                            )
-                        joined = self._probe_with_filter(
-                            lbt, b, right_keys, JoinSide.INNER, contig
-                        )
-                        out = self._restore_column_order(
-                            joined, b, lbt.batch, build_is_right=False
-                        )
-                        self.metrics.add("output_batches")
-                        yield maybe_shrink(out, ctx, site, 0)
+            lfp, lflags = learned
+            if partition != 0:
                 return
+            from ballista_tpu.exec.shrink import maybe_shrink
+
+            cached = self._build_cache.get(("bt_flip",))
+            if cached is not None:
+                left_batch, lbt = cached
+            else:
+                with self.metrics.time("build_time"):
+                    left_batch = _collect(self.left, ctx)
+                    lbt = build_side(left_batch, left_keys)
+                self._build_cache_put(
+                    ctx, ("bt_flip",), left_batch, lbt, left_keys
+                )
+            ctx.defer_speculation(
+                lbt.spec_flag(),
+                "cached join build strategy went stale (flip side "
+                "no longer unique)",
+                [lfp, ("join_lut", lfp)],
+            )
+            contig = self._contig_probe(lbt, lflags, True, ctx, lfp)
+            site = self.display()
+            rpart = self.right.output_partitioning()
+            for p in range(rpart.n):
+                for b in self.right.execute(p, ctx):
+                    if not contig:
+                        # per-batch: the general path gates the LUT
+                        # on the COLLECTED probe capacity, which the
+                        # stream never materializes — re-offering
+                        # each batch converges to the same decision
+                        # (the helper early-outs once attached or
+                        # once the domain is learned unusable)
+                        self._maybe_attach_lut(
+                            lbt, b.capacity, ctx, lfp
+                        )
+                    joined = self._probe_with_filter(
+                        lbt, b, right_keys, JoinSide.INNER, contig
+                    )
+                    out = self._restore_column_order(
+                        joined, b, lbt.batch, build_is_right=False
+                    )
+                    self.metrics.add("output_batches")
+                    yield maybe_shrink(out, ctx, site, 0)
+            return
 
         cached_r = self._build_cache.get(("bt_right",))
         if cached_r is not None:
             right_batch = cached_r[0]
         else:
             with self.metrics.time("build_time"):
-                right_batch = _collect(self.right, ctx)
+                right_batch = self._collect_right(ctx)
 
         iter_first = iter(self.left.execute(partition, ctx))
         first = next(iter_first, None)

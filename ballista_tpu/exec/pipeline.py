@@ -25,6 +25,57 @@ from ballista_tpu.expr import logical as L
 from ballista_tpu.expr.physical import compile_expr
 
 
+def prefetch_slices(load, items, depth: int, metrics=None):
+    """Double-buffered pipeline: run ``load(item)`` on ONE background host
+    thread, keeping up to ``depth`` results in flight beyond the one being
+    consumed, and yield results in order.
+
+    This is the compute/IO overlap primitive of the streamed scan
+    (exec/scan.py): while the device works through slice i's batches, the
+    worker reads/decodes slice i+1 and stages its host->device transfer —
+    so scan-bound queries hide parquet decode behind device time. A single
+    worker keeps host memory bounded at ``depth + 1`` slices and preserves
+    read order (parquet readers are not safely shared across concurrent
+    readers anyway).
+
+    ``metrics`` (a Metrics set) records ``prefetch_hits`` (result was
+    ready when the consumer asked) vs ``prefetch_misses`` (consumer had to
+    wait — the first slice always misses, IO-bound pipelines mostly miss).
+    """
+    items = list(items)
+    if depth <= 0 or len(items) <= 1:
+        for it in items:
+            yield load(it)
+        return
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    ex = ThreadPoolExecutor(max_workers=1, thread_name_prefix="scan-prefetch")
+    try:
+        pending: deque = deque()
+        idx = 0
+        # fill to depth, not depth+1: one result is always held by the
+        # consumer after the first yield, so residency is depth+1 slices
+        while idx < len(items) and len(pending) < depth:
+            pending.append(ex.submit(load, items[idx]))
+            idx += 1
+        while pending:
+            fut = pending.popleft()
+            if metrics is not None:
+                metrics.add(
+                    "prefetch_hits" if fut.done() else "prefetch_misses"
+                )
+            out = fut.result()
+            if idx < len(items):
+                pending.append(ex.submit(load, items[idx]))
+                idx += 1
+            yield out
+    finally:
+        # an abandoned consumer (LIMIT) must not leave the worker reading
+        # a file the caller is about to close
+        ex.shutdown(wait=True, cancel_futures=True)
+
+
 def fusable_chain(plan: ExecutionPlan):
     """(source, ops): the maximal Filter/Projection chain hanging off
     ``plan``, ops innermost-first; source is the first non-fusable input."""

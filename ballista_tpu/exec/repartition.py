@@ -45,6 +45,20 @@ def _jit_mask_partition(key_idxs: tuple, n: int):
     return jax.jit(f, static_argnames=("p",))
 
 
+@functools.lru_cache(maxsize=None)
+def jit_partition_ids(key_idxs: tuple, num_partitions: int):
+    """Jitted per-batch partition-id program, shared by every consumer of
+    the hash-routing rule — the shuffle writer (executor/shuffle.py) and
+    the grace-hash spill paths (exec/spill.py callers). Dictionary hash
+    tables ride as runtime args (they change per batch dictionary; baking
+    them at trace time would mis-route later batches)."""
+    return jax.jit(
+        lambda b, tables: partition_ids(
+            b, list(key_idxs), num_partitions, tables
+        )
+    )
+
+
 class HashRepartitionExec(ExecutionPlan):
     def __init__(
         self,

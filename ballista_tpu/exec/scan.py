@@ -584,38 +584,51 @@ class ParquetScanExec(ExecutionPlan):
         gbytes: list[int],
         ctx: TaskContext,
     ) -> Iterator[DeviceBatch]:
+        from ballista_tpu.exec.pipeline import prefetch_slices
+
         batch_rows = self.batch_rows or ctx.config.tpu_batch_rows()
         narrow = self._narrowable_from_stats(f)
         dicts = self._stream_dicts(f)
         self.metrics.add("stream_slices", 0)
         names = [fld.name for fld in self._schema]
+        slices: list[list[int]] = []
         cur: list[int] = []
         cur_b = 0
         for g, gb in zip(groups, gbytes):
             cur.append(g)
             cur_b += gb
             if cur_b >= self.STREAM_SLICE_BYTES:
-                yield from self._stream_slice(
-                    f, cur, names, batch_rows, narrow, dicts
-                )
+                slices.append(cur)
                 cur, cur_b = [], 0
         if cur:
-            yield from self._stream_slice(
-                f, cur, names, batch_rows, narrow, dicts
-            )
+            slices.append(cur)
 
-    def _stream_slice(
+        def load(gs: list[int]) -> list[DeviceBatch]:
+            return self._load_slice(f, gs, names, batch_rows, narrow, dicts)
+
+        # Double-buffered prefetch (ballista.tpu.prefetch_depth): a host
+        # thread reads/decodes the NEXT slice and stages its device upload
+        # while the current slice's batches compute downstream. depth=0
+        # degrades to the serial read-compute-read loop.
+        for batches in prefetch_slices(
+            load, slices, ctx.config.prefetch_depth(), self.metrics
+        ):
+            self.metrics.add("stream_slices")
+            for b in batches:
+                self.metrics.add("output_rows", b.count_valid())
+                yield b
+
+    def _load_slice(
         self, f, groups, names, batch_rows, narrow, dicts
-    ) -> Iterator[DeviceBatch]:
+    ) -> list[DeviceBatch]:
+        """Read + convert + stage one row-group slice. Runs on the
+        prefetch worker when enabled; DeviceBatch.from_host starts the
+        host->device transfer, so the next slice's upload overlaps the
+        current slice's compute."""
         with self.metrics.time("read_time"):
             t = f.read_row_groups(groups, columns=self.projection or None)
         t = t.select(names)
-        self.metrics.add("stream_slices")
-        for b in table_from_arrow(
-            t, batch_rows, narrow, fixed_dicts=dicts
-        ):
-            self.metrics.add("output_rows", b.count_valid())
-            yield b
+        return table_from_arrow(t, batch_rows, narrow, fixed_dicts=dicts)
 
     def _narrowable_from_stats(self, f: "papq.ParquetFile") -> frozenset:
         """INT64 columns whose min/max over EVERY row group (from parquet

@@ -1,10 +1,17 @@
-"""Shuffle-data housekeeping: TTL-based work_dir garbage collection.
+"""Shuffle/spill housekeeping: TTL-based work_dir garbage collection.
 
 ref ballista/rust/executor/src/main.rs:193-257 — ``clean_shuffle_data_loop``
 runs every ``job_data_clean_up_interval_seconds``; a job directory whose
 most recent modification is older than ``job_data_ttl_seconds`` is deleted
 (the scheduler keeps no reference to it past job completion + client fetch).
-"""
+
+The same sweep also covers grace-hash spill files (exec/spill.py). Spill
+directories under a job's work_dir (``<work_dir>/<job>/spill``) are deleted
+with the job by ``clean_shuffle_data``; spills of contexts WITHOUT a
+work_dir land in the shared temp root and are swept by
+``clean_spill_data`` — both are attempt-scoped and deleted eagerly at the
+attempt boundary in normal operation, so the sweeps only matter after a
+crash."""
 
 from __future__ import annotations
 
@@ -52,6 +59,32 @@ def clean_shuffle_data(work_dir: str, ttl_seconds: float) -> list[str]:
     return deleted
 
 
+def clean_spill_data(ttl_seconds: float, root: str | None = None) -> list[str]:
+    """Delete orphaned grace-hash spill attempt directories from the shared
+    temp root (exec/spill.py SPILL_TMP_ROOT) idle for longer than the TTL.
+    Live attempts keep writing (fresh mtimes), so only directories whose
+    owner died are old enough to collect. Returns the deleted names."""
+    if root is None:
+        from ballista_tpu.exec.spill import SPILL_TMP_ROOT as root
+    deleted: list[str] = []
+    if not os.path.isdir(root):
+        return deleted
+    now = time.time()
+    for entry in os.listdir(root):
+        attempt_dir = os.path.join(root, entry)
+        if not os.path.isdir(attempt_dir):
+            continue
+        try:
+            if now - _newest_mtime(attempt_dir) > ttl_seconds:
+                shutil.rmtree(attempt_dir, ignore_errors=True)
+                deleted.append(entry)
+        except OSError as e:
+            log.warning("spill cleanup of %s failed: %s", attempt_dir, e)
+    if deleted:
+        log.info("cleaned %d orphaned spill dirs: %s", len(deleted), deleted)
+    return deleted
+
+
 def start_cleanup_loop(
     work_dir: str,
     ttl_seconds: float,
@@ -65,6 +98,7 @@ def start_cleanup_loop(
         while not stop.wait(interval_seconds):
             try:
                 clean_shuffle_data(work_dir, ttl_seconds)
+                clean_spill_data(ttl_seconds)
             except Exception:  # noqa: BLE001
                 log.exception("shuffle cleanup sweep failed")
 

@@ -15,12 +15,9 @@ coalesce boundary, ref planner.rs:62-78). Returns per-file metadata
 
 from __future__ import annotations
 
-import functools
 import os
 from typing import Iterator
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
 import pyarrow.ipc as paipc
@@ -35,20 +32,10 @@ from ballista_tpu.exec.base import (
     TaskContext,
     UnknownPartitioning,
 )
+from ballista_tpu.exec.repartition import jit_partition_ids
 from ballista_tpu.expr import logical as L
-from ballista_tpu.ops.partition import partition_ids, string_key_tables
+from ballista_tpu.ops.partition import string_key_tables
 from ballista_tpu.scheduler_types import ShuffleWritePartitionMeta
-
-
-@functools.lru_cache(maxsize=None)
-def _jit_partition_ids(key_idxs: tuple, num_partitions: int):
-    # dict_tables ride as runtime args (they change per batch dictionary;
-    # baking them at trace time would mis-route later batches)
-    return jax.jit(
-        lambda b, tables: partition_ids(
-            b, list(key_idxs), num_partitions, tables
-        )
-    )
 
 
 class ShuffleWriterExec(ExecutionPlan):
@@ -125,7 +112,7 @@ class ShuffleWriterExec(ExecutionPlan):
                 with self.metrics.time("repart_time"):
                     tables = string_key_tables(batch, list(key_idxs))
                     pids = np.asarray(
-                        _jit_partition_ids(key_idxs, self.output_partitions)(
+                        jit_partition_ids(key_idxs, self.output_partitions)(
                             batch, tables
                         )
                     )

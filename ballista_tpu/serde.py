@@ -727,7 +727,7 @@ class BallistaCodec:
                 mesh_sort=pb.PhysicalMeshSortNode(
                     input=self.physical_to_proto(plan.input),
                     sort_exprs=_sort_exprs_to_proto(plan.sort_exprs),
-                    fetch=plan.fetch,
+                    fetch=-1 if plan.fetch is None else plan.fetch,
                 )
             )
         if isinstance(plan, CrossJoinExec):
@@ -992,7 +992,9 @@ class BallistaCodec:
             return MeshSortExec(
                 self.physical_from_proto(n.input),
                 _sort_exprs_from_proto(n.sort_exprs),
-                int(n.fetch),
+                # unbounded sort: -1 by the fetch convention above; 0 from
+                # plans encoded before the convention reached this node
+                None if n.fetch <= 0 else int(n.fetch),
                 self._mesh_runtime(),
             )
         if kind == "cross_join":

@@ -17,7 +17,18 @@ BENCH_DETAIL.json and stderr.
 Env knobs: BENCH_SF (default 1; 0.1 for a quick run), BENCH_ITERS
 (default 3), BENCH_QUERIES (comma list, default q1,q3,q5,q6,q18),
 BENCH_SKIP_CPU=1, BENCH_PREWARM=0 to disable the parallel compile
-prewarm. On a fresh compilation cache the suite's cold passes are
+prewarm. BENCH_CONFIG applies extra session settings
+("ballista.tpu.hbm_budget_mb=16384,ballista.tpu.scan_stream_mb=2048");
+BENCH_PARQUET=1 registers the tables as parquet files (written once to
+BENCH_PARQUET_DIR, default ./bench_data/sf<SF>) so the streamed-scan +
+prefetch paths and row-group pruning are exercised — the SF>=10
+out-of-core configurations. BENCH_STREAM_SLICE_MB shrinks the streamed
+slice (default 1GB) and BENCH_ROW_GROUP_ROWS the written row groups
+(default 1M rows) so the prefetch A/B also runs at small SF.
+Details land in BENCH_DETAIL.json (SF=1) or
+BENCH_SF<SF>_DETAIL.json, with peak host RSS, per-query spill bytes /
+passes, and — when a query streamed — a prefetch-disabled A/B warm
+timing. On a fresh compilation cache the suite's cold passes are
 dominated by serial XLA compiles (tens of seconds per program over the
 tunnelled compile service), so the harness first runs every query ONCE
 in concurrent subprocesses — the tunnelled chip multiplexes processes
@@ -41,72 +52,187 @@ ITERS = int(os.environ.get("BENCH_ITERS", "3"))
 QUERIES = os.environ.get("BENCH_QUERIES", "q1,q3,q5,q6,q18").split(",")
 
 
+def _bench_config():
+    from ballista_tpu.config import BallistaConfig
+
+    # single-chip suite: host-side partition splitting only multiplies
+    # blocking syncs (the XLA program parallelizes internally); distributed
+    # partitioning is exercised by the cluster tests, not the chip bench
+    cfg = BallistaConfig().with_setting("ballista.shuffle.partitions", "1")
+    for kv in os.environ.get("BENCH_CONFIG", "").split(","):
+        if kv.strip():
+            k, v = kv.split("=", 1)
+            cfg = cfg.with_setting(k.strip(), v.strip())
+    return cfg
+
+
+def _register_tables(ctx) -> tuple[dict, float]:
+    """Register the TPC-H tables; returns ({name: rows}, gen_seconds).
+    BENCH_PARQUET=1 writes the tables once to parquet (multiple row
+    groups, so the streamed scan / prefetch / pruning paths run) and
+    registers the files; generation is skipped entirely when the files
+    already exist — at SF>=10 that is most of a cold run's wall clock."""
+    import pyarrow.parquet as papq
+
+    from ballista_tpu.tpch import all_schemas
+
+    names = list(all_schemas())
+    rows: dict = {}
+    if os.environ.get("BENCH_PARQUET"):
+        pdir = pathlib.Path(
+            os.environ.get("BENCH_PARQUET_DIR", HERE / "bench_data")
+        ) / f"sf{SF:g}"
+        gen_s = 0.0
+        missing = [n for n in names if not (pdir / f"{n}.parquet").exists()]
+        if missing:
+            from ballista_tpu.tpch import gen_all
+
+            pdir.mkdir(parents=True, exist_ok=True)
+            t0 = time.time()
+            data = gen_all(scale=SF)
+            rg_rows = int(os.environ.get("BENCH_ROW_GROUP_ROWS", 1 << 20))
+            for name in missing:
+                papq.write_table(
+                    data[name], pdir / f"{name}.parquet",
+                    row_group_size=rg_rows,
+                )
+            gen_s = time.time() - t0
+        for name in names:
+            path = str(pdir / f"{name}.parquet")
+            ctx.register_parquet(name, path)
+            rows[name] = papq.ParquetFile(path).metadata.num_rows
+        return rows, gen_s
+    from ballista_tpu.tpch import gen_all
+
+    t0 = time.time()
+    data = gen_all(scale=SF)
+    gen_s = time.time() - t0
+    for name, t in data.items():
+        ctx.register_table(name, t)
+        rows[name] = t.num_rows
+    return rows, gen_s
+
+
+def _peak_rss_mb() -> float:
+    import resource
+
+    return round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1
+    )
+
+
+_PLAN_COUNTERS = (
+    "spill_bytes", "spill_passes", "stream_slices",
+    "prefetch_hits", "prefetch_misses",
+)
+
+
+def _plan_counters(phys) -> dict:
+    from ballista_tpu.exec.base import plan_counters
+
+    return {
+        k: v for k, v in plan_counters(phys, _PLAN_COUNTERS).items() if v
+    }
+
+
+def _collect_with_plan(ctx, sql: str):
+    """(table, rows, executed plan) — the plan so per-query metrics
+    (spill bytes, prefetch hit ratio) can be read AFTER the run."""
+    t, phys = ctx.sql(sql).collect_with_plan()
+    return t, t.num_rows, phys
+
+
 def run_suite() -> dict:
     """Run the query set in-process on the current JAX backend."""
     sys.path.insert(0, str(HERE))
     import jax
 
     from ballista_tpu.exec.context import TpuContext
-    from ballista_tpu.tpch import gen_all
 
     backend = jax.devices()[0].platform
-    t0 = time.time()
-    data = gen_all(scale=SF)
-    gen_s = time.time() - t0
-    from ballista_tpu.config import BallistaConfig
+    cfg = _bench_config()
+
+    ssmb = os.environ.get("BENCH_STREAM_SLICE_MB")
+    if ssmb:
+        # shrink streamed-scan slices so the prefetch A/B is exercisable
+        # below SF=10 (default slice is 1GB: smaller runs see one slice
+        # and the overlap has nothing to hide behind)
+        from ballista_tpu.exec.scan import ParquetScanExec
+
+        ParquetScanExec.STREAM_SLICE_BYTES = int(float(ssmb) * (1 << 20))
 
     if os.environ.get("BENCH_PREWARM_CHILD"):
         # compile-prewarm mode: execute each query once (populating the
         # persistent compilation cache) and exit — timings are discarded
-        ctx = TpuContext(
-            BallistaConfig().with_setting("ballista.shuffle.partitions", "1")
-        )
-        for name, t in data.items():
-            ctx.register_table(name, t)
+        ctx = TpuContext(cfg)
+        _register_tables(ctx)
         for qn in QUERIES:
             ctx.sql((QDIR / f"{qn}.sql").read_text()).collect()
         print("{}")
         return {}
 
-    # single-chip suite: host-side partition splitting only multiplies
-    # blocking syncs (the XLA program parallelizes internally); distributed
-    # partitioning is exercised by the cluster tests, not the chip bench
-    ctx = TpuContext(
-        BallistaConfig().with_setting("ballista.shuffle.partitions", "1")
-    )
-    rows = {}
-    for name, t in data.items():
-        ctx.register_table(name, t)
-        rows[name] = t.num_rows
+    ctx = TpuContext(cfg)
+    rows, gen_s = _register_tables(ctx)
 
     out = {
         "backend": backend,
         "sf": SF,
         "gen_seconds": round(gen_s, 2),
         "table_rows": rows,
+        "config": cfg.settings(),
         "queries": {},
     }
+    prefetch_on = cfg.prefetch_depth() > 0
     for qn in QUERIES:
         sql = (QDIR / f"{qn}.sql").read_text()
         t0 = time.time()
-        res = ctx.sql(sql).collect()
+        _, nrows, phys = _collect_with_plan(ctx, sql)
         cold = time.time() - t0
         warms = []
         for _ in range(ITERS):
             t0 = time.time()
-            res = ctx.sql(sql).collect()
+            _, nrows, phys = _collect_with_plan(ctx, sql)
             warms.append(time.time() - t0)
-        out["queries"][qn] = {
+        counters = _plan_counters(phys)
+        q = {
             "cold_s": round(cold, 4),
             "warm_s": [round(w, 4) for w in warms],
             "warm_best_s": round(min(warms), 4),
-            "rows": res.num_rows,
+            "rows": nrows,
             "lineitem_rows_per_s": int(rows["lineitem"] / min(warms)),
+            **counters,
         }
+        hits = counters.get("prefetch_hits", 0)
+        misses = counters.get("prefetch_misses", 0)
+        if hits + misses:
+            q["prefetch_hit_ratio"] = round(hits / (hits + misses), 3)
+        if prefetch_on and counters.get("stream_slices", 0) > 1:
+            # prefetch A/B on streamed queries: same data, same run, depth
+            # 0 — the acceptance signal that compute/IO overlap pays
+            old = ctx.config
+            ctx.config = old.with_setting("ballista.tpu.prefetch_depth", "0")
+            try:
+                _collect_with_plan(ctx, sql)  # cold (fresh plan instance)
+                nwarmeans = []
+                for _ in range(ITERS):
+                    t0 = time.time()
+                    _collect_with_plan(ctx, sql)
+                    nwarmeans.append(time.time() - t0)
+            finally:
+                ctx.config = old
+            q["warm_noprefetch_s"] = [round(w, 4) for w in nwarmeans]
+            q["prefetch_speedup"] = round(
+                min(nwarmeans) / max(min(warms), 1e-9), 3
+            )
+        out["queries"][qn] = q
     out["warm_total_s"] = round(
         sum(q["warm_best_s"] for q in out["queries"].values()), 4
     )
     out["queries_per_s"] = round(len(QUERIES) / out["warm_total_s"], 4)
+    out["peak_rss_mb"] = _peak_rss_mb()
+    out["spill_bytes_total"] = sum(
+        q.get("spill_bytes", 0) for q in out["queries"].values()
+    )
     return out
 
 
@@ -270,9 +396,13 @@ def main() -> None:
     # Pinned denominator: a frozen, committed CPU-baseline artifact so
     # round-over-round ratios measure the DEVICE, not drift in a shared
     # host's CPU timings (observed ±30% swings across rounds). Freeze the
-    # current live CPU suite with BENCH_FREEZE=1; vs_frozen is reported
-    # alongside the live ratio whenever SF + query set match.
-    frozen_path = HERE / "BENCH_BASELINE.json"
+    # current live CPU suite with BENCH_FREEZE=1. Frozen baselines are
+    # KEYED BY SCALE FACTOR (one file per SF) so SF=10/SF=100 runs report
+    # vs_frozen_cpu against their own denominator instead of silently
+    # falling back to the live CPU ratio; the legacy un-keyed file is
+    # still honored for SF=1 readers of old artifacts.
+    frozen_path = HERE / f"BENCH_BASELINE_SF{SF:g}.json"
+    legacy_path = HERE / "BENCH_BASELINE.json"
     vs_frozen = None
     if cpu_run is not None and os.environ.get("BENCH_FREEZE"):
         frozen_path.write_text(
@@ -281,9 +411,11 @@ def main() -> None:
                 indent=2,
             )
         )
-    if frozen_path.exists():
+    for path in (frozen_path, legacy_path):
+        if not path.exists():
+            continue
         try:
-            frozen = json.loads(frozen_path.read_text())
+            frozen = json.loads(path.read_text())
             if frozen.get("sf") == SF and frozen.get("queries") == sorted(
                 QUERIES
             ):
@@ -293,10 +425,14 @@ def main() -> None:
                 )
                 vs_frozen = round(ft / device_run["warm_total_s"], 3)
                 detail["frozen_cpu_total_s"] = round(ft, 4)
+                break
         except (json.JSONDecodeError, KeyError, TypeError):
             pass
 
-    (HERE / "BENCH_DETAIL.json").write_text(json.dumps(detail, indent=2))
+    detail_path = HERE / (
+        "BENCH_DETAIL.json" if SF == 1 else f"BENCH_SF{SF:g}_DETAIL.json"
+    )
+    detail_path.write_text(json.dumps(detail, indent=2))
     print(json.dumps(detail, indent=2), file=sys.stderr)
 
     vs = 0.0
