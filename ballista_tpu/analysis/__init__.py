@@ -1,0 +1,26 @@
+"""Static analysis subsystem (``planlint``).
+
+Three passes that move whole classes of executor-runtime failures to
+submission/collection time:
+
+- :mod:`ballista_tpu.analysis.verifier` — pre-execution plan verification
+  (schema agreement, column resolution, TPU dtype legality, shuffle
+  partition-count consistency, stage-DAG well-formedness), wired into every
+  submission path behind ``ballista.tpu.verify_plans``.
+- :mod:`ballista_tpu.analysis.serde_audit` — structural closure audit of the
+  plan/expression serde vocabulary: every node class either round-trips
+  byte-stably through the proto codec or is explicitly exempted.
+- :mod:`ballista_tpu.analysis.jaxlint` — AST lint for JAX/TPU hazards
+  (tracer branching, host sync inside jit, missing static_argnames,
+  dynamic-shape primitives) over ``ops/`` and ``exec/``, plus a per-kernel
+  static signature report.
+"""
+
+from ballista_tpu.errors import PlanVerificationError  # noqa: F401
+from ballista_tpu.analysis.verifier import (  # noqa: F401
+    VerifyReport,
+    sql_span,
+    verify_logical,
+    verify_physical,
+    verify_stages,
+)

@@ -34,6 +34,7 @@ HELP = """\
 \\quiet [on|off] print or set quiet mode
 \\pset format F  set output format (table|csv|tsv|json|ndjson)
 statements end with ';'
+EXPLAIN [VERBOSE] VERIFY <query>;  static plan verification report
 """
 
 

@@ -106,11 +106,24 @@ class BallistaContext(TpuContext):
         if not isinstance(stmt, (ast.Select, ast.SetOp)):
             return super().sql(sql)
         logical = SqlPlanner(self).plan(stmt)
-        return self._frame(logical)
+        frame = self._frame(logical)
+        frame._sql = sql  # verifier diagnostics carry a source span
+        return frame
 
-    def collect_logical(self, logical: LogicalPlan) -> pa.Table:
+    def collect_logical(
+        self, logical: LogicalPlan, sql: str | None = None
+    ) -> pa.Table:
         """Submit a logical plan, poll to completion, fetch partitions
         (the DistributedQueryExec flow)."""
+        if self.config.verify_plans():
+            # client-side gate: a plan that cannot execute fails HERE with
+            # an operator path (and SQL span when known) instead of as an
+            # opaque failed-job error from an executor. The scheduler
+            # re-verifies its physical/stage plans server-side.
+            from ballista_tpu.analysis import verify_logical
+            from ballista_tpu.plan.optimizer import optimize
+
+            verify_logical(optimize(logical), sql=sql)
         node = logical_to_proto(logical)
         result = self._stub.ExecuteQuery(
             pb.ExecuteQueryParams(
@@ -177,4 +190,4 @@ class RemoteDataFrame(DataFrame):
     def collect(self) -> pa.Table:
         if self._const is not None:
             return self._const
-        return self.ctx.collect_logical(self.logical)
+        return self.ctx.collect_logical(self.logical, sql=self._sql)

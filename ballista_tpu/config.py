@@ -45,6 +45,7 @@ BALLISTA_HBM_BUDGET_MB = "ballista.tpu.hbm_budget_mb"  # grace-hash trigger
 BALLISTA_SPILL_BUDGET_MB = "ballista.tpu.spill_budget_mb"  # host spill ceiling
 BALLISTA_SPILL_DIR = "ballista.tpu.spill_dir"  # grace-hash spill location
 BALLISTA_PREFETCH_DEPTH = "ballista.tpu.prefetch_depth"  # streamed-scan overlap
+BALLISTA_VERIFY_PLANS = "ballista.tpu.verify_plans"  # static plan verification
 
 
 class TaskSchedulingPolicy(Enum):
@@ -220,6 +221,18 @@ def _entries() -> dict[str, ConfigEntry]:
             "1",
             int,
         ),
+        ConfigEntry(
+            BALLISTA_VERIFY_PLANS,
+            "Statically verify plans before execution/submission "
+            "(ballista_tpu/analysis/verifier.py): schema agreement, column "
+            "resolution, TPU dtype legality, shuffle partition-count "
+            "consistency, stage-DAG well-formedness. Errors surface as "
+            "PlanVerificationError at submission time instead of failing "
+            "on an executor mid-query. On by default; off trades the "
+            "(sub-ms) walk for zero submission-path checking.",
+            "true",
+            _parse_bool,
+        ),
     ]
     return {e.name: e for e in ents}
 
@@ -331,6 +344,9 @@ class BallistaConfig:
 
     def collective_shuffle(self) -> bool:
         return self._get(BALLISTA_COLLECTIVE_SHUFFLE)
+
+    def verify_plans(self) -> bool:
+        return self._get(BALLISTA_VERIFY_PLANS)
 
     def __eq__(self, other) -> bool:
         return (

@@ -27,6 +27,33 @@ class SqlError(BallistaError):
     """SQL parse/analysis failure (ref error.rs SqlError)."""
 
 
+class PlanVerificationError(PlanError):
+    """Static plan verification failure (ballista_tpu/analysis/verifier.py).
+
+    Raised BEFORE any stage is scheduled, so schema mismatches, unresolved
+    columns, illegal TPU dtypes, and shuffle partition-count disagreements
+    become submission-time errors instead of executor-runtime ones.
+    ``path`` names the operator chain root -> offending node; ``span`` is a
+    1-based (line, column) into the source SQL when the offending token
+    could be located there."""
+
+    def __init__(
+        self,
+        message: str,
+        path: tuple = (),
+        span: "tuple[int, int] | None" = None,
+    ):
+        self.reason = message
+        self.path = tuple(path)
+        self.span = span
+        parts = [message]
+        if self.path:
+            parts.append("at " + " > ".join(self.path))
+        if span is not None:
+            parts.append(f"(SQL line {span[0]}, column {span[1]})")
+        super().__init__("; ".join(parts))
+
+
 class SchemaError(BallistaError):
     """Schema mismatch or unknown column."""
 

@@ -276,8 +276,20 @@ class TpuContext(Catalog, TableProvider):
                 sig.append((name, r.kw["path"], mt))
         return tuple(sig)
 
-    def create_physical_plan(self, logical: LogicalPlan) -> ExecutionPlan:
+    def create_physical_plan(
+        self, logical: LogicalPlan, sql: str | None = None
+    ) -> ExecutionPlan:
         optimized = optimize(logical)
+        verify = self.config.verify_plans()
+        if verify:
+            # errors move left: prove the plan executable BEFORE running
+            # it (schema agreement, column resolution, dtype legality).
+            # ``sql`` (when the plan came from sql()) lets diagnostics
+            # carry a source span. Cached physical plans below were
+            # verified when first planned.
+            from ballista_tpu.analysis import verify_logical
+
+            verify_logical(optimized, sql=sql)
         # serde bytes, not display(): display renders aliased exprs by
         # alias name only, so textually different queries can share a
         # display — the proto encoding is structurally exact
@@ -317,6 +329,10 @@ class TpuContext(Catalog, TableProvider):
         phys = PhysicalPlanner(
             self, partitions, mesh_runtime=self.mesh_runtime()
         ).plan(optimized)
+        if verify:
+            from ballista_tpu.analysis import verify_physical
+
+            verify_physical(phys, sql=sql)
         if key is not None:
             self._physical_cache[key] = phys
         return phys
@@ -351,11 +367,22 @@ class TpuContext(Catalog, TableProvider):
                 ("logical_plan", logical.display()),
                 ("optimized_plan", optimized.display()),
             ]
-            if stmt.verbose:
+            # one physical plan serves both VERBOSE display and VERIFY —
+            # the report must describe the plan the user sees; planned
+            # with mesh_runtime so it is also the plan that would execute
+            phys = None
+            if stmt.verbose or stmt.verify:
                 phys = PhysicalPlanner(
-                    self, self.config.default_shuffle_partitions()
+                    self,
+                    self.config.default_shuffle_partitions(),
+                    mesh_runtime=self.mesh_runtime(),
                 ).plan(optimized)
+            if stmt.verbose:
                 rows.append(("physical_plan", phys.display()))
+            if stmt.verify:
+                rows.append(
+                    ("verification", self._verify_report(optimized, phys, sql))
+                )
             t = pa.table(
                 {
                     "plan_type": pa.array([r[0] for r in rows]),
@@ -364,8 +391,27 @@ class TpuContext(Catalog, TableProvider):
             )
             return DataFrame.from_arrow(self, t)
         if isinstance(stmt, (ast.Select, ast.SetOp)):
-            return DataFrame(self, SqlPlanner(self).plan(stmt))
+            df = DataFrame(self, SqlPlanner(self).plan(stmt))
+            df._sql = sql  # verifier diagnostics carry a source span
+            return df
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    def _verify_report(self, optimized: LogicalPlan, phys, sql: str) -> str:
+        """EXPLAIN VERIFY body: run the logical + physical verifier passes
+        over the ALREADY-planned physical tree (the same one VERBOSE
+        displays) and render their reports; a verification failure becomes
+        report text (EXPLAIN must not raise — it exists to show the
+        diagnosis)."""
+        from ballista_tpu.analysis import verify_logical, verify_physical
+        from ballista_tpu.errors import PlanVerificationError
+
+        lines = []
+        try:
+            lines.append(verify_logical(optimized, sql=sql).summary())
+            lines.append(verify_physical(phys, sql=sql).summary())
+        except PlanVerificationError as e:
+            lines.append(f"FAILED: {e}")
+        return "\n".join(lines)
 
     def _create_external_table(self, stmt: ast.CreateExternalTable) -> None:
         if stmt.name in self.tables:
@@ -402,6 +448,10 @@ class DataFrame:
         self.ctx = ctx
         self.logical = logical
         self._const: pa.Table | None = None
+        # source SQL when this frame came from sql() — lets plan
+        # verification diagnostics point at a line/column. Builder-derived
+        # frames drop it (their plan no longer matches the text).
+        self._sql: str | None = None
 
     # -- builder -------------------------------------------------------------
     def _derive(self, logical: LogicalPlan) -> "DataFrame":
@@ -538,6 +588,7 @@ class DataFrame:
         df.ctx = ctx
         df.logical = None
         df._const = table
+        df._sql = None
         return df
 
     @classmethod
@@ -556,7 +607,7 @@ class DataFrame:
         collect() is the (table-only) user surface."""
         if self._const is not None:
             return self._const, None
-        phys = self.ctx.create_physical_plan(self.logical)
+        phys = self.ctx.create_physical_plan(self.logical, sql=self._sql)
         part = phys.output_partitioning()
         n = part.n if isinstance(part, UnknownPartitioning) else part.n
 

@@ -397,6 +397,11 @@ class MeshWindowExec(ExecutionPlan):
         self.runtime = runtime
         # local operator: validation, schema, and the per-shard programs
         self._local = WindowExec(input, window_exprs, names)
+        # the serde codec re-encodes these field-for-field; SHARED with
+        # _local (not copies) so the wire format can never drift from
+        # what executes
+        self.window_exprs = self._local.window_exprs
+        self.names = self._local.names
         key_sets = {frozenset(pk) for pk, _ in self._local._keys}
         if len(key_sets) != 1 or not next(iter(key_sets)):
             raise PlanError(

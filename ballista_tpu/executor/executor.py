@@ -50,6 +50,11 @@ class Executor:
         self.work_dir = work_dir
         self.provider = provider
         self.codec = BallistaCodec(provider=provider)
+        # re-verify decoded stage plans before running them (catches serde
+        # drift between scheduler and executor builds). StandaloneCluster
+        # turns this off: in-proc, the scheduler just verified the same
+        # bytes it hands over, so the second walk buys nothing.
+        self.verify_decoded_plans = True
         # adaptive-capacity memory across tasks (run_with_capacity_retry)
         self._capacity_hint: dict = {}
         self._plan_cache: dict = {}
@@ -79,8 +84,13 @@ class Executor:
                 f"(got {type(plan).__name__})"
             )
         props = props_early
+        config = BallistaConfig(props) if props else BallistaConfig()
+        if self.verify_decoded_plans and config.verify_plans():
+            from ballista_tpu.analysis import verify_physical
+
+            verify_physical(plan)
         out = run_with_capacity_retry(
-            BallistaConfig(props) if props else BallistaConfig(),
+            config,
             lambda ctx: plan.execute_shuffle_write(
                 task.task_id.partition_id, ctx
             ),

@@ -128,6 +128,26 @@ def run(n_devices: int) -> None:
 def main() -> None:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
     run(n)
+    # planlint static surface: the per-kernel signature report over
+    # ops/ + exec/ — which jit parameters are static (recompile keys) vs
+    # traced — printed beside the mesh-placement assertions so a hazard
+    # introduced by a kernel change fails the same gate that proves the
+    # distributed pipeline.
+    from ballista_tpu.analysis.jaxlint import static_signature_report
+
+    report = static_signature_report()
+    hazards = [h for k in report.values() for h in k["hazards"]]
+    print(f"planlint: {len(report)} jitted kernels, {len(hazards)} hazards")
+    for name, info in sorted(report.items()):
+        static = ", ".join(info["static"]) or "-"
+        print(f"  {name}  static[{static}]")
+    for h in hazards:
+        print(f"  HAZARD {h}")
+    if hazards:
+        # not an assert: the gate must hold under `python -O` too
+        raise SystemExit(
+            f"{len(hazards)} JAX hazards (see planlint output above)"
+        )
     print(f"dryrun ok on {n} devices")
 
 

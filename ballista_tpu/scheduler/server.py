@@ -337,6 +337,15 @@ class SchedulerServer:
     def submit_logical(self, logical, session_id: str) -> str:
         cfg = self.sessions.get(session_id, self.config)
         optimized = optimize(logical)
+        verify = cfg.verify_plans()
+        if verify:
+            # submission-time gate: reject inconsistent plans with a typed
+            # PlanVerificationError (naming the operator path) BEFORE any
+            # stage exists — the client sees it as the job-submission
+            # failure rather than an executor task failure minutes later
+            from ballista_tpu.analysis import verify_logical
+
+            verify_logical(optimized)
         # distributed=True inserts HashRepartitionExec exchange boundaries
         # (honoring ballista.repartition.*) so the stage splitter can cut
         # multi-partition hash shuffles (ref planner.rs:133-157)
@@ -347,6 +356,10 @@ class SchedulerServer:
             distributed=True,
             mesh_runtime=self._mesh_planning_runtime(cfg),
         ).plan(optimized)
+        if verify:
+            from ballista_tpu.analysis import verify_physical
+
+            verify_physical(physical)
         return self.submit_physical(physical, session_id)
 
     def _mesh_planning_runtime(self, cfg):
@@ -387,6 +400,18 @@ class SchedulerServer:
         try:
             planner = DistributedPlanner()
             stages = planner.plan_query_stages(job_id, plan)
+            cfg = self.sessions.get(
+                self.jobs[job_id].session_id, self.config
+            )
+            if cfg.verify_plans():
+                # stage-DAG well-formedness: every UnresolvedShuffleExec
+                # placeholder must agree with its writer stage on schema
+                # and partition count, and reference an earlier stage —
+                # the splitter bug class that otherwise dies mid-job on
+                # an executor
+                from ballista_tpu.analysis import verify_stages
+
+                verify_stages(stages)
         except Exception as e:  # noqa: BLE001
             self._on_job_failed(job_id, f"planning failed: {e}")
             return

@@ -730,6 +730,18 @@ class BallistaCodec:
                     fetch=-1 if plan.fetch is None else plan.fetch,
                 )
             )
+        from ballista_tpu.exec.mesh import MeshWindowExec
+
+        if isinstance(plan, MeshWindowExec):
+            return pb.PhysicalPlanNode(
+                mesh_window=pb.PhysicalMeshWindowNode(
+                    input=self.physical_to_proto(plan.input),
+                    exprs=[
+                        _window_expr_to_proto(w) for w in plan.window_exprs
+                    ],
+                    names=list(plan.names),
+                )
+            )
         if isinstance(plan, CrossJoinExec):
             return pb.PhysicalPlanNode(
                 cross_join=pb.PhysicalBinaryNode(
@@ -997,6 +1009,16 @@ class BallistaCodec:
                 None if n.fetch <= 0 else int(n.fetch),
                 self._mesh_runtime(),
             )
+        if kind == "mesh_window":
+            from ballista_tpu.exec.mesh import MeshWindowExec
+
+            n = p.mesh_window
+            return MeshWindowExec(
+                self.physical_from_proto(n.input),
+                [_window_expr_from_proto(w) for w in n.exprs],
+                list(n.names),
+                self._mesh_runtime(),
+            )
         if kind == "cross_join":
             return CrossJoinExec(
                 self.physical_from_proto(p.cross_join.left),
@@ -1082,23 +1104,32 @@ class BallistaCodec:
         if n.kind == "memory":
             if self.provider is None:
                 raise InternalError("memory scan decode requires a provider")
-            return self.provider.scan(
+            plan = self.provider.scan(
                 n.table_name, projection, n.partitions or 1
             )
-        schema = schema_from_proto(n.table_schema)
-        if n.kind == "csv":
-            return CsvScanExec(
-                n.path, schema, n.has_header, n.delimiter or ",",
-                projection, n.partitions or 1,
-            )
-        if n.kind == "avro":
-            return AvroScanExec(
-                n.path, schema, projection, n.partitions or 1,
-            )
-        return ParquetScanExec(
-            n.path, schema, projection, n.partitions or 1,
-            predicates=[expr_from_proto(e) for e in n.filters],
-        )
+        else:
+            schema = schema_from_proto(n.table_schema)
+            if n.kind == "csv":
+                plan = CsvScanExec(
+                    n.path, schema, n.has_header, n.delimiter or ",",
+                    projection, n.partitions or 1,
+                )
+            elif n.kind == "avro":
+                plan = AvroScanExec(
+                    n.path, schema, projection, n.partitions or 1,
+                )
+            else:
+                plan = ParquetScanExec(
+                    n.path, schema, projection, n.partitions or 1,
+                    predicates=[expr_from_proto(e) for e in n.filters],
+                )
+        # the physical planner stamps table_name on the plan it encodes;
+        # dropping it on decode made decoded plans un-RE-encodable (memory
+        # scans hard-fail; file scans silently lost the name) — a decoded
+        # stage plan reloaded from scheduler persistent state could then
+        # never be dispatched again (serde-closure audit finding)
+        plan.table_name = n.table_name
+        return plan
 
 
 def loc_to_proto(loc) -> pb.PartitionLocation:

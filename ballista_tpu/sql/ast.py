@@ -168,6 +168,10 @@ class ShowColumns:
 class Explain:
     verbose: bool
     query: "Select | SetOp"
+    # EXPLAIN VERIFY: run the static plan verifier
+    # (ballista_tpu/analysis/verifier.py) and print its report alongside
+    # the plans instead of executing anything
+    verify: bool = False
 
 
 Statement = (

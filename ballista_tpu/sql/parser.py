@@ -139,7 +139,15 @@ class Parser:
         if t.is_kw("explain"):
             self.next()
             verbose = self.accept_kw("verbose")
-            return ast.Explain(verbose, self.parse_query())
+            # VERIFY is contextual (only meaningful right after
+            # EXPLAIN [VERBOSE]), NOT a reserved word — `select verify
+            # from t` must keep parsing as an identifier
+            verify = False
+            nt = self.peek()
+            if nt.kind == Tok.IDENT and nt.value.lower() == "verify":
+                self.next()
+                verify = True
+            return ast.Explain(verbose, self.parse_query(), verify=verify)
         raise SqlError(f"unsupported statement starting with {t.value!r}")
 
     def parse_create(self) -> ast.CreateExternalTable:

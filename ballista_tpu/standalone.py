@@ -61,6 +61,12 @@ class StandaloneCluster:
             work_dir=work_dir,
             provider=provider,
         )
+        # in-proc the scheduler verified every stage plan at submission
+        # (ballista.tpu.verify_plans) and the executor decodes the very
+        # same bytes — skip the per-task re-verification walk. Remote
+        # executors keep it: their build may disagree with the
+        # scheduler's serde vocabulary.
+        executor.verify_decoded_plans = False
         _svc, flight_port, _t = start_flight_server("127.0.0.1", 0, work_dir)
         if policy == TaskSchedulingPolicy.PUSH_STAGED:
             from ballista_tpu.executor.executor_server import ExecutorServer
