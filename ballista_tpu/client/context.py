@@ -70,12 +70,18 @@ class BallistaContext(TpuContext):
         config: BallistaConfig | None = None,
         concurrent_tasks: int = 4,
         policy=None,
+        n_executors: int = 1,
+        executor_timeout_s: float = 60.0,
+        expiry_check_interval_s: float = 15.0,
     ) -> "BallistaContext":
         """Boot an in-proc scheduler + executor over localhost gRPC/Flight
         (ref context.rs:137-207 + scheduler/standalone.rs +
         executor/standalone.rs) — full cluster semantics in one process.
         ``policy`` selects pull- vs push-staged task scheduling
-        (ref scheduler/src/main.rs:87-95 ``--scheduler-policy``)."""
+        (ref scheduler/src/main.rs:87-95 ``--scheduler-policy``);
+        ``n_executors`` boots a multi-executor cluster (chaos tests kill
+        one and assert recovery; the liveness knobs tighten the expiry
+        sweep so those tests run in seconds)."""
         from ballista_tpu.config import TaskSchedulingPolicy
         from ballista_tpu.standalone import StandaloneCluster
 
@@ -83,6 +89,9 @@ class BallistaContext(TpuContext):
             config,
             concurrent_tasks,
             policy=policy or TaskSchedulingPolicy.PULL_STAGED,
+            n_executors=n_executors,
+            executor_timeout_s=executor_timeout_s,
+            expiry_check_interval_s=expiry_check_interval_s,
         )
         ctx = cls(f"localhost:{cluster.scheduler_port}", config)
         ctx._standalone_cluster = cluster

@@ -4,16 +4,113 @@ ref ballista/rust/core/src/client.rs:50-178 (BallistaClient): encode a
 protobuf Action{FetchPartition} as the Flight Ticket, `do_get`, read the
 IPC stream. pyarrow.flight is Arrow C++ Flight underneath — the native
 data plane the reference uses, not a Python reimplementation.
+
+Fetch-level resilience (docs/fault_tolerance.md):
+
+- Connections are cached per ``(host, port)`` — a shuffle-wide fan-in
+  dials each peer once instead of per partition, and one flaky handshake
+  no longer turns into a hard error on an otherwise-healthy stream.
+- Every fetch attempt carries a deadline (``ballista.tpu.fetch_timeout_s``)
+  and transient transport errors (unavailable / timed out) retry up to
+  ``ballista.tpu.fetch_retries`` times with bounded exponential backoff +
+  deterministic jitter (``ballista.tpu.fetch_backoff_ms``).
+- Exhausted retries — and non-transient errors (corrupt stream, server-side
+  missing file), where redialing cannot help — escalate to a typed
+  :class:`ShuffleFetchError` naming the producing (executor, job, stage,
+  partition) so the scheduler can recompute the lost map output instead of
+  failing the job.
+- Retries only happen while NOTHING has been yielded yet: once batches
+  flowed downstream, a silent re-fetch would duplicate rows, so mid-stream
+  failures escalate immediately.
 """
 
 from __future__ import annotations
 
+import contextlib
+import hashlib
+import threading
+import time
+
 import pyarrow as pa
 import pyarrow.flight as paflight
 
-from ballista_tpu.errors import GrpcError
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.errors import ShuffleFetchError
 from ballista_tpu.proto import pb
 from ballista_tpu.scheduler_types import PartitionLocation
+
+# library defaults (the config entry defaults); callers with a session
+# config (ShuffleReaderExec) pass explicit values instead
+_DEFAULTS = BallistaConfig()
+DEFAULT_FETCH_RETRIES = _DEFAULTS.fetch_retries()
+DEFAULT_FETCH_BACKOFF_MS = _DEFAULTS.fetch_backoff_ms()
+DEFAULT_FETCH_TIMEOUT_S = _DEFAULTS.fetch_timeout_s()
+
+# Transient transport failures: another attempt against the same endpoint
+# can succeed (executor restarting, listen backlog full, deadline blown by
+# a GC pause). Everything else is treated as non-transient — corrupt IPC
+# data or a server that answers-but-errors won't be fixed by redialing.
+_TRANSIENT_FLIGHT_ERRORS = (
+    paflight.FlightUnavailableError,
+    paflight.FlightTimedOutError,
+    # cancellations surface when a concurrent user of the shared pooled
+    # channel saw a transport error first and evicted it — the data is not
+    # lost, a redial succeeds
+    paflight.FlightCancelledError,
+)
+
+_POOL: dict[tuple[str, int], paflight.FlightClient] = {}
+_POOL_LOCK = threading.Lock()
+
+
+def _client_for(host: str, port: int) -> paflight.FlightClient:
+    """Cached Flight connection per (host, port). Arrow's FlightClient is
+    thread-safe; concurrent shuffle readers share one channel per peer."""
+    key = (host, port)
+    with _POOL_LOCK:
+        client = _POOL.get(key)
+        if client is None:
+            client = paflight.connect(f"grpc://{host}:{port}")
+            _POOL[key] = client
+        return client
+
+
+def _evict(host: str, port: int, client: paflight.FlightClient) -> None:
+    """Drop a connection that produced a transport error (if it is still
+    the cached one) so the next attempt redials instead of reusing a
+    poisoned channel. Deliberately does NOT close(): other threads may be
+    mid-do_get on the shared channel, and closing under them would turn
+    their healthy streams into spurious failures — the evicted client is
+    closed by GC once the last user drops it."""
+    key = (host, port)
+    with _POOL_LOCK:
+        if _POOL.get(key) is client:
+            del _POOL[key]
+
+
+def close_pool() -> None:
+    """Close every cached connection (tests / process shutdown)."""
+    with _POOL_LOCK:
+        clients = list(_POOL.values())
+        _POOL.clear()
+    for c in clients:
+        with contextlib.suppress(Exception):
+            c.close()
+
+
+def backoff_s(loc: PartitionLocation, attempt: int, backoff_ms: int) -> float:
+    """Bounded exponential backoff with deterministic +-25% jitter keyed by
+    (location, attempt) — reproducible under the fault harness, and
+    de-synchronized across the many readers that lose the same executor at
+    once (no thundering-herd redial)."""
+    if backoff_ms <= 0:
+        return 0.0
+    base = min(backoff_ms * (2 ** attempt), backoff_ms * 100) / 1000.0
+    h = hashlib.sha256(
+        repr((loc.job_id, loc.stage_id, loc.partition, attempt)).encode()
+    ).digest()
+    jitter = 0.75 + 0.5 * (h[0] / 255.0)
+    return base * jitter
 
 
 def make_ticket(loc: PartitionLocation) -> paflight.Ticket:
@@ -28,32 +125,131 @@ def make_ticket(loc: PartitionLocation) -> paflight.Ticket:
     return paflight.Ticket(action.SerializeToString())
 
 
-def fetch_partition(loc: PartitionLocation) -> pa.Table:
+def _call_options(timeout_s: float) -> paflight.FlightCallOptions:
+    if timeout_s and timeout_s > 0:
+        return paflight.FlightCallOptions(timeout=timeout_s)
+    return paflight.FlightCallOptions()
+
+
+def _escalate(loc: PartitionLocation, exc: Exception, transient: bool):
+    return ShuffleFetchError(
+        f"failed to fetch shuffle partition from {loc.host}:{loc.port}: "
+        f"{type(exc).__name__}: {exc}",
+        job_id=loc.job_id,
+        stage_id=loc.stage_id,
+        partition=loc.partition,
+        executor_id=loc.executor_id,
+        transient=transient,
+    )
+
+
+def _inject_fetch_fault(loc: PartitionLocation, attempt: int) -> None:
+    from ballista_tpu.testing import faults
+
+    inj = faults.active()
+    if inj is None:
+        return
+    from ballista_tpu.testing.faults import InjectedFetchError
+
+    try:
+        inj.on_fetch_attempt(
+            loc.job_id, loc.stage_id, loc.partition, attempt
+        )
+    except InjectedFetchError as e:
+        # surface as the transient-transport flavor so the retry/backoff
+        # path is exercised exactly like a real unavailable endpoint
+        raise paflight.FlightUnavailableError(str(e)) from e
+
+
+def fetch_partition(
+    loc: PartitionLocation,
+    retries: int | None = None,
+    backoff_ms: int | None = None,
+    timeout_s: float | None = None,
+) -> pa.Table:
     """ref client.rs fetch_partition (:75-130). Materializes the whole
     partition — use for RESULT fetches; shuffle readers should stream via
-    fetch_partition_batches."""
-    try:
-        client = paflight.connect(f"grpc://{loc.host}:{loc.port}")
-        return client.do_get(make_ticket(loc)).read_all()
-    except paflight.FlightError as e:
-        raise GrpcError(
-            f"failed to fetch partition {loc.job_id}/{loc.stage_id}/"
-            f"{loc.partition} from {loc.host}:{loc.port}: {e}"
-        ) from e
+    fetch_partition_batches. ``read_all`` is atomic (nothing is consumed
+    on failure), so every transient attempt is safely retryable."""
+    retries = DEFAULT_FETCH_RETRIES if retries is None else max(1, retries)
+    backoff_ms = (
+        DEFAULT_FETCH_BACKOFF_MS if backoff_ms is None else backoff_ms
+    )
+    timeout_s = DEFAULT_FETCH_TIMEOUT_S if timeout_s is None else timeout_s
+    for attempt in range(retries):
+        client = None
+        try:
+            _inject_fetch_fault(loc, attempt)
+            client = _client_for(loc.host, loc.port)
+            return client.do_get(
+                make_ticket(loc), options=_call_options(timeout_s)
+            ).read_all()
+        except _TRANSIENT_FLIGHT_ERRORS as e:
+            if client is not None:
+                _evict(loc.host, loc.port, client)
+            if attempt + 1 >= retries:
+                raise _escalate(loc, e, transient=True) from e
+            time.sleep(backoff_s(loc, attempt, backoff_ms))
+        except (paflight.FlightError, pa.ArrowInvalid, pa.ArrowIOError) as e:
+            raise _escalate(loc, e, transient=False) from e
+    raise AssertionError("unreachable")  # pragma: no cover
 
 
-def fetch_partition_batches(loc: PartitionLocation):
+def fetch_partition_batches(
+    loc: PartitionLocation,
+    retries: int | None = None,
+    backoff_ms: int | None = None,
+    timeout_s: float | None = None,
+):
     """Stream a remote shuffle partition batch-at-a-time (the server side
     is a GeneratorStream over the IPC file) — peak memory is one record
-    batch, not the partition."""
-    try:
-        client = paflight.connect(f"grpc://{loc.host}:{loc.port}")
-        reader = client.do_get(make_ticket(loc))
-        for chunk in reader:
-            if chunk.data is not None:
-                yield chunk.data
-    except paflight.FlightError as e:
-        raise GrpcError(
-            f"failed to fetch partition {loc.job_id}/{loc.stage_id}/"
-            f"{loc.partition} from {loc.host}:{loc.port}: {e}"
-        ) from e
+    batch, not the partition.
+
+    Generator hygiene: a downstream consumer that stops early (LIMIT)
+    triggers GeneratorExit — the in-flight Flight read is cancelled in the
+    ``finally`` so the stream isn't leaked (the pooled CONNECTION stays
+    cached by design; only the per-call reader is torn down)."""
+    retries = DEFAULT_FETCH_RETRIES if retries is None else max(1, retries)
+    backoff_ms = (
+        DEFAULT_FETCH_BACKOFF_MS if backoff_ms is None else backoff_ms
+    )
+    timeout_s = DEFAULT_FETCH_TIMEOUT_S if timeout_s is None else timeout_s
+
+    yielded = False
+    for attempt in range(retries):
+        client = None
+        reader = None
+        try:
+            _inject_fetch_fault(loc, attempt)
+            client = _client_for(loc.host, loc.port)
+            reader = client.do_get(
+                make_ticket(loc), options=_call_options(timeout_s)
+            )
+            try:
+                for chunk in reader:
+                    if chunk.data is not None:
+                        yielded = True
+                        yield chunk.data
+            finally:
+                # closes the stream on normal exhaustion AND on
+                # GeneratorExit from an early-stopping consumer
+                with contextlib.suppress(Exception):
+                    reader.cancel()
+            return
+        except _TRANSIENT_FLIGHT_ERRORS as e:
+            if client is not None:
+                _evict(loc.host, loc.port, client)
+            if yielded:
+                # batches already flowed downstream: a restart would
+                # duplicate rows — escalate to a clean task-level retry
+                raise _escalate(loc, e, transient=True) from e
+            if attempt + 1 >= retries:
+                raise _escalate(loc, e, transient=True) from e
+            time.sleep(backoff_s(loc, attempt, backoff_ms))
+        except ShuffleFetchError:
+            raise
+        except (paflight.FlightError, pa.ArrowInvalid, pa.ArrowIOError) as e:
+            # non-transient: data corruption or a server-side error (e.g.
+            # the shuffle file is gone). Redialing cannot help; recomputing
+            # the producing stage can.
+            raise _escalate(loc, e, transient=False) from e

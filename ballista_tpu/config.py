@@ -46,6 +46,16 @@ BALLISTA_SPILL_BUDGET_MB = "ballista.tpu.spill_budget_mb"  # host spill ceiling
 BALLISTA_SPILL_DIR = "ballista.tpu.spill_dir"  # grace-hash spill location
 BALLISTA_PREFETCH_DEPTH = "ballista.tpu.prefetch_depth"  # streamed-scan overlap
 BALLISTA_VERIFY_PLANS = "ballista.tpu.verify_plans"  # static plan verification
+BALLISTA_TASK_MAX_ATTEMPTS = "ballista.tpu.task_max_attempts"  # bounded task retries
+BALLISTA_FETCH_RETRIES = "ballista.tpu.fetch_retries"  # Flight fetch attempts
+BALLISTA_FETCH_BACKOFF_MS = "ballista.tpu.fetch_backoff_ms"  # base fetch backoff
+BALLISTA_FETCH_TIMEOUT_S = "ballista.tpu.fetch_timeout_s"  # per-attempt deadline
+
+# Task-scoped keys the scheduler stamps onto TaskDefinition props for the
+# executor (attempt number for fault keying / logging). NOT session config:
+# executors strip this prefix before building BallistaConfig.
+BALLISTA_INTERNAL_PREFIX = "ballista.internal."
+BALLISTA_INTERNAL_TASK_ATTEMPT = "ballista.internal.task_attempt"
 
 
 class TaskSchedulingPolicy(Enum):
@@ -233,6 +243,45 @@ def _entries() -> dict[str, ConfigEntry]:
             "true",
             _parse_bool,
         ),
+        ConfigEntry(
+            BALLISTA_TASK_MAX_ATTEMPTS,
+            "Max execution attempts per task before the job fails. On a "
+            "retryable failure the scheduler requeues the task "
+            "(FAILED -> PENDING) preferring an executor the task has not "
+            "failed on; deterministic errors (PlanVerificationError and "
+            "the rest of errors.NON_RETRYABLE_ERROR_TYPES) short-circuit "
+            "straight to JobFailed. Also bounds lost-shuffle recompute "
+            "rounds per producing stage (docs/fault_tolerance.md). 1 "
+            "disables retries.",
+            "3",
+            int,
+        ),
+        ConfigEntry(
+            BALLISTA_FETCH_RETRIES,
+            "Attempts per shuffle-partition Flight fetch before the fetch "
+            "escalates to a ShuffleFetchError (scheduler-level recompute). "
+            "Only transient transport errors (unavailable/timeout) are "
+            "retried; data corruption escalates immediately.",
+            "3",
+            int,
+        ),
+        ConfigEntry(
+            BALLISTA_FETCH_BACKOFF_MS,
+            "Base backoff (ms) between fetch attempts; grows exponentially "
+            "per attempt with +-25% deterministic jitter, capped at 100x "
+            "the base.",
+            "50",
+            int,
+        ),
+        ConfigEntry(
+            BALLISTA_FETCH_TIMEOUT_S,
+            "Per-attempt deadline (seconds) on a shuffle fetch Flight call "
+            "— a blackholed executor must fail the attempt, not wedge the "
+            "reading task forever. Generous by default: it bounds a whole "
+            "partition stream, not one batch. 0 disables.",
+            "300",
+            float,
+        ),
     ]
     return {e.name: e for e in ents}
 
@@ -347,6 +396,18 @@ class BallistaConfig:
 
     def verify_plans(self) -> bool:
         return self._get(BALLISTA_VERIFY_PLANS)
+
+    def task_max_attempts(self) -> int:
+        return max(1, self._get(BALLISTA_TASK_MAX_ATTEMPTS))
+
+    def fetch_retries(self) -> int:
+        return max(1, self._get(BALLISTA_FETCH_RETRIES))
+
+    def fetch_backoff_ms(self) -> int:
+        return max(0, self._get(BALLISTA_FETCH_BACKOFF_MS))
+
+    def fetch_timeout_s(self) -> float:
+        return max(0.0, self._get(BALLISTA_FETCH_TIMEOUT_S))
 
     def __eq__(self, other) -> bool:
         return (

@@ -242,7 +242,16 @@ def remove_unresolved_shuffles(
     stages' partition locations (ref planner.rs:207-255).
 
     ``partition_locations[stage_id][output_partition] -> [PartitionLocation]``
-    """
+
+    COPY-ON-WRITE: nodes on the path to a replaced placeholder are
+    shallow-copied before their child slots are rebound, untouched subtrees
+    are shared, and ``plan`` itself is never mutated. The scheduler depends
+    on this: it keeps each stage's UNRESOLVED plan as a pristine template
+    so lost-shuffle recovery can re-resolve the stage against refreshed
+    partition locations after an upstream recompute (an in-place patch
+    would destroy the placeholders the second resolution needs)."""
+    import copy
+
     from ballista_tpu.executor.reader import ShuffleReaderExec
 
     if isinstance(plan, UnresolvedShuffleExec):
@@ -256,4 +265,6 @@ def remove_unresolved_shuffles(
         remove_unresolved_shuffles(c, partition_locations)
         for c in plan.children()
     ]
-    return _with_children(plan, children)
+    if all(a is b for a, b in zip(plan.children(), children)):
+        return plan  # no placeholder below: share the subtree
+    return _with_children(copy.copy(plan), children)

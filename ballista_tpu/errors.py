@@ -6,6 +6,8 @@ ballista/rust/core/src/error.rs:33-185) as a Python exception hierarchy.
 
 from __future__ import annotations
 
+import re
+
 
 class BallistaError(Exception):
     """Base error for the framework (ref error.rs:33)."""
@@ -85,6 +87,101 @@ class CapacityError(ExecutionError):
     def __init__(self, message: str, required: int = 0):
         super().__init__(message)
         self.required = int(required)
+
+
+class ShuffleFetchError(ExecutionError):
+    """A shuffle partition could not be fetched from the executor that
+    produced it (dead executor, deleted/corrupt file, unreachable Flight
+    endpoint after bounded retries).
+
+    Carries the SOURCE of the lost data — (job, map stage, map output
+    partition, producing executor) — so the scheduler can invalidate
+    exactly that executor's completed shuffle outputs and re-run the lost
+    map partitions (Spark-style lineage recovery) instead of failing the
+    job. ``transient=False`` marks data corruption: redialing cannot help,
+    but recomputing the upstream stage can, so both flavors escalate to
+    scheduler-level recompute — the flag only controls whether fetch-level
+    retries were worth attempting first.
+
+    The executor reports task failures as strings; ``__str__`` embeds a
+    machine-parseable source tag that :func:`parse_shuffle_fetch_error`
+    recovers scheduler-side (no proto change needed)."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        job_id: str = "",
+        stage_id: int = -1,
+        partition: int = -1,
+        executor_id: str = "",
+        transient: bool = True,
+    ):
+        self.reason = message
+        self.job_id = job_id
+        self.stage_id = int(stage_id)
+        self.partition = int(partition)
+        self.executor_id = executor_id
+        self.transient = transient
+        tag = (
+            f"[shuffle-fetch job={job_id} stage={self.stage_id} "
+            f"partition={self.partition} executor={executor_id}]"
+        )
+        super().__init__(f"{tag} {message}")
+
+
+_SHUFFLE_FETCH_TAG = re.compile(
+    r"\[shuffle-fetch job=(?P<job>\S*) stage=(?P<stage>-?\d+) "
+    r"partition=(?P<part>-?\d+) executor=(?P<exec>[^\]]*)\]"
+)
+
+
+def parse_shuffle_fetch_error(error: str):
+    """Recover the (job_id, stage_id, partition, executor_id) source tag a
+    :class:`ShuffleFetchError` embeds in its message, or None when the
+    error string is not a shuffle-fetch failure. Used by the scheduler to
+    route a downstream task failure into lost-shuffle recovery."""
+    m = _SHUFFLE_FETCH_TAG.search(error or "")
+    if m is None:
+        return None
+    return (
+        m.group("job"),
+        int(m.group("stage")),
+        int(m.group("part")),
+        m.group("exec"),
+    )
+
+
+# Deterministic failures: re-running the identical task re-derives the
+# identical error, so the scheduler short-circuits straight to JobFailed
+# with zero retries. Keyed by exception TYPE NAME because task errors
+# cross the wire as "TypeName: message" strings (executor.as_task_status).
+# ExecutionError/CapacityError/ShuffleFetchError/grpc failures stay
+# retryable: another attempt (possibly on another executor) can succeed.
+NON_RETRYABLE_ERROR_TYPES = frozenset(
+    {
+        "PlanVerificationError",
+        "PlanError",
+        "SqlError",
+        "SchemaError",
+        "ConfigError",
+        "InternalError",
+        "NotImplementedError_",
+        "NotImplementedError",
+        "TypeError",
+        "AttributeError",
+    }
+)
+
+
+def error_is_retryable(error: str) -> bool:
+    """Classify a wire-format task error ("TypeName: message..."): False
+    for the deterministic taxonomy above, True otherwise (unknown errors
+    default to retryable — a wasted bounded retry is cheaper than failing
+    a recoverable job)."""
+    head = (error or "").lstrip()
+    type_name = head.split(":", 1)[0].strip()
+    return type_name not in NON_RETRYABLE_ERROR_TYPES
 
 
 class SpeculationMiss(ExecutionError):

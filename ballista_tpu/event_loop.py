@@ -54,7 +54,15 @@ class EventLoop:
 
     def stop(self) -> None:
         self._stop.set()
-        self._q.put(None)
+        # non-blocking wake-up: a blocking put() deadlocked here whenever
+        # the bounded queue was full at shutdown (the consumer may already
+        # have observed _stop and exited, so nothing ever drains the queue).
+        # If the queue is full the sentinel is unnecessary anyway — _run's
+        # timed get() observes _stop within one tick.
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.action.on_stop()
@@ -74,7 +82,12 @@ class EventLoop:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            event = self._q.get()
+            # timed get: honor _stop between events even when no sentinel
+            # ever arrives (stop() with a full queue cannot enqueue one)
+            try:
+                event = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
             try:
                 if event is None:
                     continue
@@ -84,7 +97,17 @@ class EventLoop:
                     self.action.on_error(e)
                     follow_up = None
                 if follow_up is not None:
-                    self._q.put(follow_up)
+                    # never block the consumer on its own full queue (a
+                    # self-deadlock: nothing else drains it); dropping a
+                    # follow-up under a 10000-event backlog is the lesser
+                    # evil and is loudly logged
+                    try:
+                        self._q.put_nowait(follow_up)
+                    except queue.Full:
+                        log.error(
+                            "event loop %s: queue full, dropping follow-up "
+                            "%r", self.name, follow_up,
+                        )
                     # account for the extra unfinished task we just created
             finally:
                 self._q.task_done()

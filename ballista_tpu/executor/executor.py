@@ -67,7 +67,39 @@ class Executor:
     ) -> list:
         """Decode + rebind work_dir + run one input partition
         (ref executor.rs:81-114)."""
+        from ballista_tpu.config import (
+            BALLISTA_INTERNAL_PREFIX,
+            BALLISTA_INTERNAL_TASK_ATTEMPT,
+        )
+
         props_early = {kv.key: kv.value for kv in task.props}
+        # task-scoped internal keys (attempt number) are NOT session config:
+        # strip them before BallistaConfig validation rejects the unknown
+        # prefix
+        attempt = int(props_early.get(BALLISTA_INTERNAL_TASK_ATTEMPT, "0"))
+        props_early = {
+            k: v
+            for k, v in props_early.items()
+            if not k.startswith(BALLISTA_INTERNAL_PREFIX)
+        }
+        from ballista_tpu.testing import faults
+
+        inj = faults.active()
+        if inj is not None:
+            # deterministic chaos: raising here flows through the task
+            # runner's catch-all and is reported as a normal task failure
+            inj.on_task_start(
+                task.task_id.job_id,
+                task.task_id.stage_id,
+                task.task_id.partition_id,
+                attempt,
+            )
+        if attempt > 0:
+            log.warning(
+                "task %s/%s/%s starting attempt %d",
+                task.task_id.job_id, task.task_id.stage_id,
+                task.task_id.partition_id, attempt,
+            )
         plugin_dir = props_early.get("ballista.plugin_dir", "")
         if plugin_dir:
             # UDF plugins must be resolvable before plan decode builds
@@ -89,13 +121,22 @@ class Executor:
             from ballista_tpu.analysis import verify_physical
 
             verify_physical(plan)
+        # attempt-isolated speculation cache: run against a SNAPSHOT and
+        # commit only on success. A failed attempt (injected crash, lost
+        # shuffle fetch midway) has executed part of the plan and recorded
+        # speculative observations (join build strategy, probe expansion)
+        # from partial data; leaking those into the retry makes the re-run
+        # diverge from a clean execution — observed as last-ULP float
+        # drift in aggregates, breaking the chaos suite's bit-exact
+        # recovery guarantee (docs/fault_tolerance.md).
+        attempt_cache = dict(self._plan_cache)
         out = run_with_capacity_retry(
             config,
             lambda ctx: plan.execute_shuffle_write(
                 task.task_id.partition_id, ctx
             ),
             hint=self._capacity_hint,
-            plan_cache=self._plan_cache,
+            plan_cache=attempt_cache,
             # plan instances are decoded fresh per task: instance-held
             # build caches would die with the task while charging the
             # shared HBM tally (see TaskContext.cache_builds)
@@ -104,6 +145,7 @@ class Executor:
             job_id=task.task_id.job_id,
             work_dir=self.work_dir,
         )
+        self._plan_cache.update(attempt_cache)
         self.metrics_collector.record_stage(
             task.task_id.job_id, task.task_id.stage_id,
             task.task_id.partition_id, plan,
@@ -184,6 +226,20 @@ class PollLoop:
         channel = grpc.insecure_channel(self.scheduler_addr)
         stub = scheduler_stub(channel)
         while not self._stop.is_set():
+            from ballista_tpu.testing import faults
+
+            inj = faults.active()
+            if inj is not None and inj.heartbeat_suppressed(
+                self.executor.executor_id
+            ):
+                # injected heartbeat blackout: pull-mode liveness IS the
+                # PollWork call, so skipping it makes the scheduler's
+                # expiry sweep see this executor die. Checked BEFORE the
+                # status drain: statuses are drained exactly once, so
+                # draining first and then skipping the poll would lose
+                # them permanently across a bounded blackout
+                time.sleep(POLL_INTERVAL)
+                continue
             # drain completed statuses (ref :219-239)
             statuses = []
             while True:
@@ -204,6 +260,11 @@ class PollLoop:
                 )
             except grpc.RpcError as e:
                 log.warning("poll_work failed: %s", e)
+                # re-enqueue the drained statuses for the next successful
+                # poll — dropping them left tasks RUNNING forever on the
+                # scheduler (statuses are reported exactly once)
+                for st in statuses:
+                    self._statuses.put(st)
                 time.sleep(1.0)
                 continue
             if result.HasField("task"):

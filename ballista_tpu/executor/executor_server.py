@@ -130,6 +130,15 @@ class ExecutorServer:
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval_s):
+            from ballista_tpu.testing import faults
+
+            inj = faults.active()
+            if inj is not None and inj.heartbeat_suppressed(
+                self.executor.executor_id
+            ):
+                # injected blackout: the scheduler's expiry sweep must see
+                # this executor go silent
+                continue
             try:
                 result = self._sched.HeartBeatFromExecutor(
                     pb.HeartBeatParams(executor_id=self.executor.executor_id),

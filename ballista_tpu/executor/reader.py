@@ -18,7 +18,7 @@ import pyarrow.ipc as paipc
 from ballista_tpu.columnar.arrow_interop import table_from_arrow
 from ballista_tpu.columnar.batch import DeviceBatch
 from ballista_tpu.datatypes import Schema
-from ballista_tpu.errors import ExecutionError
+from ballista_tpu.errors import ShuffleFetchError
 from ballista_tpu.exec.base import (
     ExecutionPlan,
     TaskContext,
@@ -32,25 +32,103 @@ BATCH_ROWS = 1 << 17
 def fetch_partition_table(loc: PartitionLocation) -> pa.Table:
     """One shuffle file -> Arrow table (local fast path, else Flight)."""
     if os.path.exists(loc.path):
-        with paipc.open_file(loc.path) as r:
-            return r.read_all()
+        try:
+            with paipc.open_file(loc.path) as r:
+                return r.read_all()
+        except (pa.ArrowInvalid, pa.ArrowIOError, OSError) as e:
+            raise _local_fetch_error(loc, e) from e
     from ballista_tpu.client.flight import fetch_partition
 
     return fetch_partition(loc)
 
 
-def fetch_partition_batches(loc: PartitionLocation) -> Iterator[pa.RecordBatch]:
+def _local_fetch_error(loc: PartitionLocation, exc: Exception):
+    """A local shuffle file that exists but cannot be decoded is lost data
+    exactly like an unreachable remote: typed so the scheduler recomputes
+    the producing map partition (corruption is non-transient — re-reading
+    the same bytes cannot help)."""
+    return ShuffleFetchError(
+        f"corrupt/unreadable local shuffle file {loc.path}: "
+        f"{type(exc).__name__}: {exc}",
+        job_id=loc.job_id,
+        stage_id=loc.stage_id,
+        partition=loc.partition,
+        executor_id=loc.executor_id,
+        transient=False,
+    )
+
+
+def fetch_partition_batches(
+    loc: PartitionLocation,
+    retries: int | None = None,
+    backoff_ms: int | None = None,
+    timeout_s: float | None = None,
+) -> Iterator[pa.RecordBatch]:
     """One shuffle file -> record-batch stream; peak memory is a batch,
     not the partition (ref shuffle_reader.rs streams batches through the
-    Flight channel; read_all here was an OOM at SF=100 shuffle widths)."""
+    Flight channel; read_all here was an OOM at SF=100 shuffle widths).
+
+    Error taxonomy (docs/fault_tolerance.md): transient transport errors
+    are retried inside the Flight client; what escapes here is a typed
+    ShuffleFetchError naming the producing (executor, stage, partition) so
+    the scheduler can recompute lost map output. Local-file corruption is
+    classified the same way — non-transient, recompute-recoverable."""
     if os.path.exists(loc.path):
-        with paipc.open_file(loc.path) as r:
-            for i in range(r.num_record_batches):
-                yield r.get_batch(i)
-        return
+        _inject_local_fetch_faults(loc, retries, backoff_ms)
+        try:
+            with paipc.open_file(loc.path) as r:
+                for i in range(r.num_record_batches):
+                    yield r.get_batch(i)
+            return
+        except (pa.ArrowInvalid, pa.ArrowIOError, OSError) as e:
+            raise _local_fetch_error(loc, e) from e
     from ballista_tpu.client.flight import fetch_partition_batches as remote
 
-    yield from remote(loc)
+    yield from remote(loc, retries, backoff_ms, timeout_s)
+
+
+def _inject_local_fetch_faults(
+    loc: PartitionLocation, retries: int | None, backoff_ms: int | None
+) -> None:
+    """Fault-injection for the LOCAL fast path: standalone clusters share a
+    filesystem, so chaos tests would never exercise fetch faults through
+    the Flight client's own injection point. Mirrors the client's retry
+    loop (same attempt keying, same backoff) so a rule like
+    ``attempt: [0, 1]`` is absorbed transparently and one exceeding the
+    retry budget escalates to the scheduler-level recompute path."""
+    from ballista_tpu.testing import faults
+
+    inj = faults.active()
+    if inj is None:
+        return
+    import time as _time
+
+    from ballista_tpu.client.flight import (
+        DEFAULT_FETCH_BACKOFF_MS,
+        DEFAULT_FETCH_RETRIES,
+        backoff_s,
+    )
+    from ballista_tpu.testing.faults import InjectedFetchError
+
+    n = DEFAULT_FETCH_RETRIES if retries is None else max(1, retries)
+    backoff = DEFAULT_FETCH_BACKOFF_MS if backoff_ms is None else backoff_ms
+    for attempt in range(n):
+        try:
+            inj.on_fetch_attempt(
+                loc.job_id, loc.stage_id, loc.partition, attempt
+            )
+            return
+        except InjectedFetchError as e:
+            if attempt + 1 >= n:
+                raise ShuffleFetchError(
+                    str(e),
+                    job_id=loc.job_id,
+                    stage_id=loc.stage_id,
+                    partition=loc.partition,
+                    executor_id=loc.executor_id,
+                    transient=True,
+                ) from e
+            _time.sleep(backoff_s(loc, attempt, backoff))
 
 
 class ShuffleReaderExec(ExecutionPlan):
@@ -101,8 +179,14 @@ class ShuffleReaderExec(ExecutionPlan):
             # int32/int64 between files and double downstream compiles)
             return table_from_arrow(t, batch_rows, frozenset())
 
+        # fetch resilience knobs travel with the session config; exhausted
+        # retries surface as a typed ShuffleFetchError that fails this task
+        # and routes the scheduler into lost-shuffle recompute
+        retries = ctx.config.fetch_retries()
+        backoff_ms = ctx.config.fetch_backoff_ms()
+        timeout_s = ctx.config.fetch_timeout_s()
         for loc in locs:
-            it = fetch_partition_batches(loc)
+            it = fetch_partition_batches(loc, retries, backoff_ms, timeout_s)
             got_any = False
             while True:
                 # only the pull is timed: flushing to device must not be

@@ -225,6 +225,11 @@ def scheduler_state(server) -> dict:
             "status": j.status,
             "n_stages": len(j.stages),
             "error": j.error,
+            # fault-tolerance visibility: bounded task retries + lost-
+            # shuffle recompute rounds (both 0 on a clean run; chaos tests
+            # assert on these)
+            "retries": j.total_retries,
+            "recomputes": j.total_recomputes,
             # per-stage DAG state + task counts (the reference UI's job
             # detail view; ref ballista/ui job/stage tables)
             "stages": server.stage_manager.job_stage_summary(j.job_id),

@@ -27,7 +27,11 @@ from ballista_tpu.distributed_plan import (
     find_unresolved_shuffles,
     remove_unresolved_shuffles,
 )
-from ballista_tpu.errors import PlanError
+from ballista_tpu.errors import (
+    PlanError,
+    error_is_retryable,
+    parse_shuffle_fetch_error,
+)
 from ballista_tpu.event_loop import EventAction, EventLoop
 from ballista_tpu.exec.base import ExecutionPlan
 from ballista_tpu.exec.planner import PhysicalPlanner, TableProvider
@@ -39,6 +43,7 @@ from ballista_tpu.scheduler.stage_manager import (
     JobFinished,
     StageFinished,
     StageManager,
+    TaskRescheduled,
     TaskState,
 )
 from ballista_tpu.scheduler_types import (
@@ -92,10 +97,19 @@ class JobInfo:
     completed_locations: list[PartitionLocation] = dataclasses.field(
         default_factory=list
     )
-    # resolved (shuffle-patched) serialized plans, per stage
+    # resolved (shuffle-patched) serialized plans, per stage. Invalidated
+    # for a consumer stage whenever a dependency's shuffle output is lost
+    # (the stage's pristine plan in `stages` is then re-resolved against
+    # refreshed locations once the producer re-completes).
     resolved_plan_bytes: dict[int, bytes] = dataclasses.field(
         default_factory=dict
     )
+    # retry policy snapshot (session config at submission) + visibility
+    # counters that outlive the per-stage bookkeeping (torn down at job
+    # completion): bounded task retries + lost-shuffle recompute rounds
+    max_attempts: int = 3
+    total_retries: int = 0
+    total_recomputes: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,6 +148,8 @@ class QueryStageScheduler(EventAction):
                 s._on_job_failed(
                     event.job_id, f"stage submission failed: {e}"
                 )
+        elif isinstance(event, TaskRescheduled):
+            s._on_task_rescheduled(event)
         elif isinstance(event, StageFinished):
             s._on_stage_finished(event.job_id, event.stage_id)
         elif isinstance(event, JobFinished):
@@ -230,8 +246,11 @@ class SchedulerServer:
 
     def check_expired_executors(self) -> list[str]:
         """Detect heartbeat-expired executors, reset their RUNNING tasks to
-        PENDING, drop them from slot accounting, and re-offer. Returns the
-        expired executor ids (exposed for tests and the REST /state view)."""
+        PENDING, invalidate their COMPLETED shuffle outputs that downstream
+        stages still need (lost-shuffle recovery — the files died with the
+        executor), drop them from slot accounting, and re-offer. Returns
+        the expired executor ids (exposed for tests and the REST /state
+        view)."""
         em = self.executor_manager
         # read tracked BEFORE alive: an executor registering between the two
         # snapshots is then in alive-but-not-tracked (harmless) instead of
@@ -248,7 +267,31 @@ class SchedulerServer:
         log.warning(
             "executors %s expired; reset %d running tasks", expired, len(reset)
         )
-        if reset and self.policy == TaskSchedulingPolicy.PUSH_STAGED:
+        # completed shuffle output hosted on a dead executor is gone; any
+        # stage with an incomplete consumer must recompute the lost map
+        # partitions (a stage whose consumers all finished is left alone —
+        # its output will never be read again)
+        recovered = False
+        for job_id, stage_id in self.stage_manager.stages_with_outputs_of(
+            expired
+        ):
+            consumers = self.stage_manager.parents_of(job_id, stage_id)
+            if consumers and all(
+                self.stage_manager.is_completed_stage(job_id, c)
+                for c in consumers
+            ):
+                continue
+            if not consumers and self.jobs.get(job_id) is not None:
+                # final stage of a still-running job: its output is the
+                # job result the client fetches — recompute it too
+                if self.jobs[job_id].final_stage_id != stage_id:
+                    continue
+            for eid in sorted(expired):
+                if self._on_shuffle_lost(job_id, stage_id, eid):
+                    recovered = True
+        if (reset or recovered) and (
+            self.policy == TaskSchedulingPolicy.PUSH_STAGED
+        ):
             self.event_loop.post(ReviveOffers())
         return sorted(expired)
 
@@ -416,6 +459,7 @@ class SchedulerServer:
             self._on_job_failed(job_id, f"planning failed: {e}")
             return
         job = self.jobs[job_id]
+        job.max_attempts = cfg.task_max_attempts()
         deps: dict[int, set[int]] = {}
         for stage in stages:
             job.stages[stage.stage_id] = stage
@@ -457,30 +501,37 @@ class SchedulerServer:
         ]
         n_tasks = stage.input_partition_count
         if unfinished:
-            self.stage_manager.add_pending_stage(job_id, stage_id, n_tasks)
+            self.stage_manager.add_pending_stage(
+                job_id, stage_id, n_tasks, max_attempts=job.max_attempts
+            )
             for u in unfinished:
                 self._submit_stage(job_id, u.stage_id, seen)
         else:
             self._resolve_stage(job_id, stage_id)
-            self.stage_manager.add_running_stage(job_id, stage_id, n_tasks)
+            self.stage_manager.add_running_stage(
+                job_id, stage_id, n_tasks, max_attempts=job.max_attempts
+            )
 
     def _resolve_stage(self, job_id: str, stage_id: int) -> None:
-        """Patch completed shuffle locations into the stage plan and
-        serialize it once (ref try_resolve_stage :181-309 +
-        task_scheduler.rs:146-156)."""
+        """Patch completed shuffle locations into a COPY of the stage plan
+        and serialize it (ref try_resolve_stage :181-309 +
+        task_scheduler.rs:146-156). ``stage.plan`` stays the pristine
+        unresolved template: lost-shuffle recovery re-invokes this after an
+        upstream recompute, and re-resolution needs the placeholders a
+        destructive patch would have consumed."""
         job = self.jobs[job_id]
         stage = job.stages[stage_id]
         unresolved = find_unresolved_shuffles(stage.plan)
+        plan = stage.plan
         if unresolved:
             locations: dict[int, list[list[PartitionLocation]]] = {}
             for u in unresolved:
                 locations[u.stage_id] = self._stage_output_locations(
                     job_id, u.stage_id, u.output_partition_count
                 )
-            resolved = remove_unresolved_shuffles(stage.plan, locations)
-            stage.plan = resolved
+            plan = remove_unresolved_shuffles(stage.plan, locations)
         job.resolved_plan_bytes[stage_id] = self.codec.physical_to_proto(
-            stage.plan
+            plan
         ).SerializeToString()
 
     def _stage_output_locations(
@@ -510,7 +561,10 @@ class SchedulerServer:
     # -- event handlers ------------------------------------------------------
     def _on_stage_finished(self, job_id: str, stage_id: int) -> None:
         """Promote pending parents whose deps are all complete (ref
-        :107-122)."""
+        :107-122). Re-resolution here is what repairs consumers after a
+        lost-shuffle recompute: their cached plan bytes were invalidated,
+        and the pristine template re-resolves against the refreshed
+        locations."""
         job = self.jobs.get(job_id)
         if job is None:
             return
@@ -523,7 +577,80 @@ class SchedulerServer:
                 for u in unresolved
             ):
                 self._resolve_stage(job_id, parent)
-                self.stage_manager.promote_pending_stage(job_id, parent)
+                for e in self.stage_manager.promote_pending_stage(
+                    job_id, parent
+                ):
+                    self.event_loop.post(e)
+
+    def _on_task_rescheduled(self, event: TaskRescheduled) -> None:
+        """Bookkeeping for a bounded retry (visibility: REST /api/state
+        exposes the count; chaos tests assert on it)."""
+        job = self.jobs.get(event.job_id)
+        if job is not None:
+            job.total_retries += 1
+        log.warning(
+            "task %s/%s/%s requeued for attempt %d: %s",
+            event.job_id, event.stage_id, event.partition_id,
+            event.attempt, event.error.splitlines()[0] if event.error else "",
+        )
+
+    def _on_shuffle_lost(
+        self, job_id: str, map_stage_id: int, executor_id: str
+    ) -> bool:
+        """Lost-shuffle (lineage) recovery: ``executor_id``'s COMPLETED
+        shuffle output of ``map_stage_id`` is unreachable — re-open exactly
+        those map partitions, roll the stage back to running, and force
+        consumers to re-resolve against refreshed locations once it
+        re-completes. Returns True when anything was invalidated.
+
+        Recompute rounds are bounded by the stage's max_attempts: an
+        output that keeps vanishing (crash-looping executor, corrupt
+        writes) must eventually fail the job instead of recomputing
+        forever."""
+        job = self.jobs.get(job_id)
+        if job is None or job.status != "running":
+            return False
+        with self._lock:
+            # atomic with the consumer demotion below, and serialized
+            # against next_task's lazy re-resolution (which re-checks
+            # producer completeness under the same lock): a resolve racing
+            # this invalidation must see either the old complete state or
+            # the demoted one, never a half-invalidated stage
+            reopened = self.stage_manager.invalidate_executor_outputs(
+                job_id, map_stage_id, {executor_id}
+            )
+            if not reopened:
+                return False
+            job.total_recomputes += 1
+            for consumer in self.stage_manager.parents_of(
+                job_id, map_stage_id
+            ):
+                job.resolved_plan_bytes.pop(consumer, None)
+                self.stage_manager.demote_running_stage(job_id, consumer)
+        rounds = self.stage_manager.stage_recomputes(job_id, map_stage_id)
+        cap = self.stage_manager.stage_max_attempts(job_id, map_stage_id)
+        log.warning(
+            "shuffle output of %s/%s on executor %s lost; re-running %d map "
+            "partitions (recompute round %d/%d)",
+            job_id, map_stage_id, executor_id, len(reopened), rounds, cap,
+        )
+        if rounds > cap:
+            self.event_loop.post(
+                JobFailed(
+                    job_id,
+                    map_stage_id,
+                    f"shuffle output of stage {map_stage_id} lost "
+                    f"{rounds} times (last on executor {executor_id}); "
+                    "recompute bound exceeded",
+                )
+            )
+            return True
+        # (stale locations were dropped and consumers demoted above, under
+        # the lock; they re-resolve from their pristine templates when the
+        # map stage re-completes: StageFinished -> _on_stage_finished)
+        if self.policy == TaskSchedulingPolicy.PUSH_STAGED:
+            self.event_loop.post(ReviveOffers())
+        return True
 
     def _on_job_finished(self, job_id: str) -> None:
         """Assemble CompletedJob locations (ref :370-388, :416-473)."""
@@ -573,7 +700,13 @@ class SchedulerServer:
         if pick is None:
             return None
         job_id, stage_id = pick
-        pending = self.stage_manager.fetch_pending_tasks(job_id, stage_id, 1)
+        # blamed-executor exclusion is a soft preference: tasks that never
+        # failed on this executor sort first, but a blamed task is still
+        # handed out when it is all that remains (a one-executor cluster
+        # must not starve itself)
+        pending = self.stage_manager.fetch_pending_tasks(
+            job_id, stage_id, 1, executor_id=executor_id
+        )
         if not pending:
             return None
         partition = pending[0]
@@ -583,25 +716,55 @@ class SchedulerServer:
         )
         for e in events:
             self.event_loop.post(e)
+        attempt = self.stage_manager.task_attempt(job_id, stage_id, partition)
         job = self.jobs[job_id]
         plan_bytes = job.resolved_plan_bytes.get(stage_id)
         if plan_bytes is None:
-            try:
-                self._resolve_stage(job_id, stage_id)
-                plan_bytes = job.resolved_plan_bytes[stage_id]
-            except Exception as e:  # noqa: BLE001
-                # roll the RUNNING mark back so the task isn't leaked on an
-                # executor that never received it, and fail the job —
-                # resolution is deterministic, retrying can't help
-                self.stage_manager.update_task_status(
-                    task_id, TaskState.PENDING
+            # lazy (re-)resolution under the server lock, serialized against
+            # _on_shuffle_lost: recovery may have demoted this stage and
+            # dropped its resolved bytes between the schedulable pick above
+            # and here. Resolving while a producer is incomplete would bake
+            # EMPTY location lists for the lost partitions into the plan —
+            # the task would then "succeed" with rows silently missing —
+            # so re-check producer completeness first and back out.
+            with self._lock:
+                unresolved = find_unresolved_shuffles(
+                    job.stages[stage_id].plan
                 )
-                self.event_loop.post(
-                    JobFailed(job_id, stage_id, f"stage resolution failed: {e}")
-                )
-                log.exception("stage %s/%s resolution failed", job_id, stage_id)
-                return None
+                if any(
+                    not self.stage_manager.is_completed_stage(
+                        job_id, u.stage_id
+                    )
+                    for u in unresolved
+                ):
+                    self.stage_manager.update_task_status(
+                        task_id, TaskState.PENDING
+                    )
+                    return None
+                try:
+                    self._resolve_stage(job_id, stage_id)
+                    plan_bytes = job.resolved_plan_bytes[stage_id]
+                except Exception as e:  # noqa: BLE001
+                    # roll the RUNNING mark back so the task isn't leaked
+                    # on an executor that never received it, and fail the
+                    # job — resolution is deterministic, retrying can't
+                    # help
+                    self.stage_manager.update_task_status(
+                        task_id, TaskState.PENDING
+                    )
+                    self.event_loop.post(
+                        JobFailed(
+                            job_id, stage_id,
+                            f"stage resolution failed: {e}",
+                        )
+                    )
+                    log.exception(
+                        "stage %s/%s resolution failed", job_id, stage_id
+                    )
+                    return None
         cfg = self.sessions.get(job.session_id, self.config)
+        from ballista_tpu.config import BALLISTA_INTERNAL_TASK_ATTEMPT
+
         return pb.TaskDefinition(
             task_id=pb.PartitionId(
                 job_id=job_id, stage_id=stage_id, partition_id=partition
@@ -610,6 +773,13 @@ class SchedulerServer:
             props=[
                 pb.KeyValuePair(key=k, value=v)
                 for k, v in cfg.settings().items()
+            ] + [
+                # task-scoped (NOT session config; executors strip the
+                # ballista.internal. prefix before building BallistaConfig):
+                # the attempt number keys fault injection and retry logging
+                pb.KeyValuePair(
+                    key=BALLISTA_INTERNAL_TASK_ATTEMPT, value=str(attempt)
+                )
             ],
             session_id=job.session_id,
         )
@@ -757,8 +927,30 @@ class SchedulerServer:
                     partitions=metas,
                 )
             elif kind == "failed":
+                error = st.failed.error
+                # a ShuffleFetchError carries the SOURCE of the lost data;
+                # trigger producer-side recovery and requeue the reader
+                # without consuming one of its own attempts (the blame
+                # belongs to the producing executor's lost output, and
+                # boundedness comes from the producer's recompute cap)
+                src = parse_shuffle_fetch_error(error)
+                count_attempt = True
+                if src is not None:
+                    src_job, src_stage, _src_part, src_exec = src
+                    recovered = self._on_shuffle_lost(
+                        src_job or tid.job_id, src_stage, src_exec
+                    )
+                    # only skip the attempt charge when recovery actually
+                    # re-opened something: otherwise (unparseable executor,
+                    # repeated loss already handled) the normal bounded
+                    # path keeps the failure from looping forever
+                    count_attempt = not recovered
                 events = self.stage_manager.update_task_status(
-                    tid, TaskState.FAILED, error=st.failed.error
+                    tid,
+                    TaskState.FAILED,
+                    error=error,
+                    retryable=error_is_retryable(error),
+                    count_attempt=count_attempt,
                 )
             elif kind == "running":
                 events = self.stage_manager.update_task_status(

@@ -48,6 +48,11 @@ class TaskInfo:
     partitions: list[ShuffleWritePartitionMeta] = dataclasses.field(
         default_factory=list
     )
+    # bounded-retry bookkeeping: attempts = FAILED transitions consumed so
+    # far (the next run is attempt number `attempts`); blamed = executors
+    # this task failed on or was lost from (handout prefers others)
+    attempts: int = 0
+    blamed: set[str] = dataclasses.field(default_factory=set)
 
 
 @dataclasses.dataclass
@@ -56,6 +61,13 @@ class Stage:
     stage_id: int
     n_tasks: int  # = input partition count of the stage's ShuffleWriter
     tasks: list[TaskInfo] = dataclasses.field(default_factory=list)
+    # retry policy (session config ballista.tpu.task_max_attempts): a task
+    # may consume this many attempts before its failure fails the job; the
+    # same bound caps lost-shuffle recompute rounds of this stage
+    max_attempts: int = 3
+    # times this stage's completed output was invalidated and re-run
+    # (lost-shuffle recovery); bounded by max_attempts
+    recomputes: int = 0
 
     def __post_init__(self):
         if not self.tasks:
@@ -98,6 +110,18 @@ class JobFailed(StageEvent):
     error: str
 
 
+@dataclasses.dataclass(frozen=True)
+class TaskRescheduled(StageEvent):
+    """A failed task was requeued (FAILED -> PENDING) for another bounded
+    attempt; `attempt` is the attempt number the NEXT run will carry."""
+
+    job_id: str
+    stage_id: int
+    partition_id: int
+    attempt: int
+    error: str
+
+
 class StageManager:
     """In-memory running/pending/completed stage maps (ref :326-356)."""
 
@@ -132,17 +156,25 @@ class StageManager:
         with self._lock:
             return set(self._dependencies.get((job_id, stage_id), set()))
 
-    def add_running_stage(self, job_id: str, stage_id: int, n_tasks: int) -> None:
+    def add_running_stage(
+        self, job_id: str, stage_id: int, n_tasks: int, max_attempts: int = 3
+    ) -> None:
         with self._lock:
             key = (job_id, stage_id)
-            self._stages[key] = Stage(job_id, stage_id, n_tasks)
+            self._stages[key] = Stage(
+                job_id, stage_id, n_tasks, max_attempts=max(1, max_attempts)
+            )
             self._running.add(key)
             self._pending.discard(key)
 
-    def add_pending_stage(self, job_id: str, stage_id: int, n_tasks: int) -> None:
+    def add_pending_stage(
+        self, job_id: str, stage_id: int, n_tasks: int, max_attempts: int = 3
+    ) -> None:
         with self._lock:
             key = (job_id, stage_id)
-            self._stages[key] = Stage(job_id, stage_id, n_tasks)
+            self._stages[key] = Stage(
+                job_id, stage_id, n_tasks, max_attempts=max(1, max_attempts)
+            )
             self._pending.add(key)
 
     def is_running_stage(self, job_id: str, stage_id: int) -> bool:
@@ -163,9 +195,16 @@ class StageManager:
 
     # -- scheduling ----------------------------------------------------------
     def fetch_pending_tasks(
-        self, job_id: str, stage_id: int, max_n: int
+        self, job_id: str, stage_id: int, max_n: int, executor_id: str = ""
     ) -> list[int]:
-        """Pending task (partition) ids of one stage, marking nothing."""
+        """Pending task (partition) ids of one stage, marking nothing.
+
+        When ``executor_id`` is given, tasks that have NOT blamed it (never
+        failed on / were lost from it) sort first — the soft "prefer a
+        different executor" retry placement. Soft, not hard: a blamed
+        executor is still offered the task when nothing else is pending,
+        so a single-executor cluster can never deadlock on its own blame
+        list."""
         with self._lock:
             stage = self._stages.get((job_id, stage_id))
             if stage is None:
@@ -175,7 +214,20 @@ class StageManager:
                 for i, t in enumerate(stage.tasks)
                 if t.state == TaskState.PENDING
             ]
+            if executor_id:
+                out.sort(
+                    key=lambda i: executor_id in stage.tasks[i].blamed
+                )
             return out[:max_n]
+
+    def task_attempt(self, job_id: str, stage_id: int, partition: int) -> int:
+        """Attempt number the next/current run of this task carries (= the
+        count of FAILED transitions consumed so far)."""
+        with self._lock:
+            stage = self._stages.get((job_id, stage_id))
+            if stage is None or not (0 <= partition < stage.n_tasks):
+                return 0
+            return stage.tasks[partition].attempts
 
     def fetch_schedulable_stage(self) -> tuple[str, int] | None:
         """A random running stage with pending tasks (ref :300-324 — random
@@ -201,10 +253,25 @@ class StageManager:
         executor_id: str = "",
         error: str = "",
         partitions: list[ShuffleWritePartitionMeta] | None = None,
+        retryable: bool = True,
+        count_attempt: bool = True,
     ) -> list[StageEvent]:
         """Apply one task status; illegal transitions are ignored (the
         reference rejects them rather than corrupting counts, :536-586).
-        Returns stage/job events triggered by this update."""
+        Returns stage/job events triggered by this update.
+
+        A FAILED update consumes one bounded attempt: while attempts remain
+        and the error is ``retryable``, the task is immediately requeued
+        through the legal FAILED -> PENDING transition (blaming the
+        executor so the next handout prefers a different one) and a
+        :class:`TaskRescheduled` event fires instead of :class:`JobFailed`.
+        ``retryable=False`` (deterministic errors — PlanVerificationError
+        and friends, see errors.NON_RETRYABLE_ERROR_TYPES) short-circuits
+        straight to JobFailed: re-running cannot change the outcome.
+        ``count_attempt=False`` requeues without consuming an attempt —
+        used for shuffle-fetch failures, which blame the *producing*
+        executor, not this task; their boundedness comes from the
+        producing stage's recompute cap instead."""
         with self._lock:
             key = (task_id.job_id, task_id.stage_id)
             stage = self._stages.get(key)
@@ -221,6 +288,7 @@ class StageManager:
             info = stage.tasks[task_id.partition_id]
             if (info.state, new_state) not in _LEGAL:
                 return []
+            blamed_executor = executor_id or info.executor_id
             info.state = new_state
             info.executor_id = executor_id or info.executor_id
             info.error = error
@@ -229,10 +297,37 @@ class StageManager:
 
             events: list[StageEvent] = []
             if new_state == TaskState.FAILED:
-                # one failed task fails the job (ref :221-227; no retry yet)
-                events.append(
-                    JobFailed(task_id.job_id, task_id.stage_id, error)
-                )
+                if blamed_executor:
+                    info.blamed.add(blamed_executor)
+                if count_attempt:
+                    info.attempts += 1
+                if not retryable:
+                    events.append(
+                        JobFailed(task_id.job_id, task_id.stage_id, error)
+                    )
+                elif info.attempts >= stage.max_attempts:
+                    events.append(
+                        JobFailed(
+                            task_id.job_id,
+                            task_id.stage_id,
+                            f"task {task_id} failed after "
+                            f"{info.attempts} attempts: {error}",
+                        )
+                    )
+                else:
+                    # bounded requeue (FAILED -> PENDING, the legal
+                    # transition the reference declares but never takes)
+                    info.state = TaskState.PENDING
+                    info.executor_id = ""
+                    events.append(
+                        TaskRescheduled(
+                            task_id.job_id,
+                            task_id.stage_id,
+                            task_id.partition_id,
+                            info.attempts,
+                            error,
+                        )
+                    )
             elif stage.is_completed and key in self._running:
                 self._running.discard(key)
                 self._completed.add(key)
@@ -244,12 +339,102 @@ class StageManager:
                     )
             return events
 
-    def promote_pending_stage(self, job_id: str, stage_id: int) -> None:
+    def promote_pending_stage(self, job_id: str, stage_id: int) -> list[StageEvent]:
+        """Pending -> running. Returns completion events in the (rare) case
+        every task already COMPLETED while the stage sat pending — possible
+        after lost-shuffle recovery demotes a running stage whose in-flight
+        tasks then all report success; without this check the stage would
+        re-enter running fully complete and no status update would ever
+        fire its StageFinished/JobFinished."""
         with self._lock:
             key = (job_id, stage_id)
-            if key in self._pending:
-                self._pending.discard(key)
-                self._running.add(key)
+            if key not in self._pending:
+                return []
+            self._pending.discard(key)
+            self._running.add(key)
+            stage = self._stages[key]
+            if not stage.is_completed:
+                return []
+            self._running.discard(key)
+            self._completed.add(key)
+            if self._final_stage.get(job_id) == stage_id:
+                return [JobFinished(job_id)]
+            return [StageFinished(job_id, stage_id)]
+
+    def demote_running_stage(self, job_id: str, stage_id: int) -> None:
+        """Running -> pending: a dependency's output was invalidated
+        (lost shuffle), so no further task of this stage may be handed out
+        until the dependency re-completes and locations are re-resolved.
+        In-flight RUNNING tasks keep running (they either fetched the data
+        before the loss — their output is valid — or will fail with a
+        ShuffleFetchError and requeue)."""
+        with self._lock:
+            key = (job_id, stage_id)
+            if key in self._running:
+                self._running.discard(key)
+                self._pending.add(key)
+
+    def invalidate_executor_outputs(
+        self, job_id: str, stage_id: int, executor_ids: set[str]
+    ) -> list[PartitionId]:
+        """Lost-shuffle recovery, producer side: COMPLETED tasks of this
+        stage whose shuffle files live on one of ``executor_ids`` are
+        re-opened (the legal COMPLETED -> PENDING transition) with their
+        partition metadata dropped, and a completed stage rolls back to
+        running so exactly the lost map partitions re-run. Blames the dead
+        executor on each re-opened task and counts one recompute round
+        against the stage. Returns the re-opened task ids (empty when the
+        executor produced nothing here — e.g. a concurrent failure already
+        invalidated it)."""
+        out: list[PartitionId] = []
+        with self._lock:
+            key = (job_id, stage_id)
+            stage = self._stages.get(key)
+            if stage is None:
+                return []
+            for i, t in enumerate(stage.tasks):
+                if (
+                    t.state == TaskState.COMPLETED
+                    and t.executor_id in executor_ids
+                ):
+                    t.state = TaskState.PENDING
+                    t.blamed.add(t.executor_id)
+                    t.executor_id = ""
+                    t.partitions = []
+                    out.append(PartitionId(job_id, stage_id, i))
+            if out:
+                stage.recomputes += 1
+                if key in self._completed:
+                    self._completed.discard(key)
+                    self._running.add(key)
+        return out
+
+    def stages_with_outputs_of(
+        self, executor_ids: set[str]
+    ) -> list[tuple[str, int]]:
+        """Stages holding COMPLETED shuffle output produced by one of
+        ``executor_ids`` — the candidates for lost-shuffle invalidation
+        when those executors expire."""
+        with self._lock:
+            return [
+                key
+                for key, stage in self._stages.items()
+                if any(
+                    t.state == TaskState.COMPLETED
+                    and t.executor_id in executor_ids
+                    for t in stage.tasks
+                )
+            ]
+
+    def stage_recomputes(self, job_id: str, stage_id: int) -> int:
+        with self._lock:
+            stage = self._stages.get((job_id, stage_id))
+            return stage.recomputes if stage is not None else 0
+
+    def stage_max_attempts(self, job_id: str, stage_id: int) -> int:
+        with self._lock:
+            stage = self._stages.get((job_id, stage_id))
+            return stage.max_attempts if stage is not None else 3
 
     def completed_partitions(
         self, job_id: str, stage_id: int
@@ -295,6 +480,10 @@ class StageManager:
                         and t.executor_id in executor_ids
                     ):
                         t.state = TaskState.PENDING
+                        # blame (prefer another executor next time) but do
+                        # NOT consume an attempt: the executor died, the
+                        # task did nothing wrong
+                        t.blamed.add(t.executor_id)
                         t.executor_id = ""
                         out.append(PartitionId(job_id, stage_id, i))
         return out
@@ -322,6 +511,11 @@ class StageManager:
                         "tasks": {
                             s.value: n for s, n in counts.items()
                         },
+                        # retry visibility: total failed attempts consumed
+                        # across this stage's tasks + lost-shuffle
+                        # recompute rounds (both 0 on a clean run)
+                        "attempts": sum(t.attempts for t in stage.tasks),
+                        "recomputes": stage.recomputes,
                     }
                 )
             return out

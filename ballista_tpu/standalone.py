@@ -1,14 +1,23 @@
-"""Standalone (in-proc) cluster: scheduler + executor in one process.
+"""Standalone (in-proc) cluster: scheduler + N executors in one process.
 
 ref ballista/rust/scheduler/src/standalone.rs:34-59 and
 ballista/rust/executor/src/standalone.rs:38-93 — the testing backbone
 (SURVEY.md §3.5): real gRPC + real Flight over localhost random ports +
 temp work dirs, full cluster semantics without a cluster.
+
+``n_executors > 1`` boots additional executors, each with its OWN work dir
+and Flight server — the substrate for chaos tests: :meth:`kill_executor`
+stops one executor's loops, tears down its Flight service, and (by
+default) deletes its shuffle files, exactly what a crashed machine looks
+like to the scheduler (heartbeats stop -> expiry sweep; fetches fail ->
+lost-shuffle recovery; see docs/fault_tolerance.md).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
+import shutil
 import tempfile
 
 from ballista_tpu.config import BallistaConfig, TaskSchedulingPolicy
@@ -19,16 +28,39 @@ from ballista_tpu.scheduler.server import SchedulerServer, start_scheduler_grpc
 
 
 @dataclasses.dataclass
+class ExecutorHandle:
+    """One in-proc executor: core object, task loop, Flight data plane."""
+
+    executor: Executor
+    # PollLoop (pull mode) or ExecutorServer (push mode); both expose .stop()
+    loop: object
+    flight_service: object
+    flight_port: int
+    work_dir: str
+    alive: bool = True
+
+
+@dataclasses.dataclass
 class StandaloneCluster:
     scheduler: SchedulerServer
     scheduler_grpc: object
     scheduler_port: int
-    executor: Executor
-    # PollLoop (pull mode) or ExecutorServer (push mode); both expose .stop()
-    poll_loop: "PollLoop | object"
-    flight_port: int
+    executors: list[ExecutorHandle]
     work_dir: str
     _tmp: tempfile.TemporaryDirectory
+
+    # -- single-executor compatibility surface -------------------------------
+    @property
+    def executor(self) -> Executor:
+        return self.executors[0].executor
+
+    @property
+    def poll_loop(self):
+        return self.executors[0].loop
+
+    @property
+    def flight_port(self) -> int:
+        return self.executors[0].flight_port
 
     @classmethod
     def start(
@@ -40,9 +72,9 @@ class StandaloneCluster:
         policy: TaskSchedulingPolicy = TaskSchedulingPolicy.PULL_STAGED,
         executor_timeout_s: float = 60.0,
         expiry_check_interval_s: float = 15.0,
+        n_executors: int = 1,
     ) -> "StandaloneCluster":
         tmp = tempfile.TemporaryDirectory(prefix="ballista-standalone-")
-        work_dir = tmp.name
 
         scheduler = SchedulerServer(
             provider=provider,
@@ -56,10 +88,38 @@ class StandaloneCluster:
             scheduler, "127.0.0.1", 0
         )
 
+        cluster = cls(
+            scheduler=scheduler,
+            scheduler_grpc=grpc_server,
+            scheduler_port=scheduler_port,
+            executors=[],
+            work_dir=tmp.name,
+            _tmp=tmp,
+        )
+        for i in range(max(1, n_executors)):
+            cluster.add_executor(
+                concurrent_tasks=concurrent_tasks,
+                provider=provider,
+                policy=policy,
+            )
+        return cluster
+
+    def add_executor(
+        self,
+        concurrent_tasks: int = 4,
+        provider: TableProvider | None = None,
+        policy: TaskSchedulingPolicy = TaskSchedulingPolicy.PULL_STAGED,
+    ) -> ExecutorHandle:
+        """Register one more executor (own work dir + Flight port) — new
+        capacity mid-run, or a replacement after :meth:`kill_executor`."""
+        idx = len(self.executors)
+        work_dir = os.path.join(self.work_dir, f"exec-{idx}")
+        os.makedirs(work_dir, exist_ok=True)
         executor = Executor(
             executor_id=new_executor_id(),
             work_dir=work_dir,
-            provider=provider,
+            provider=provider if provider is not None
+            else self.scheduler.provider,
         )
         # in-proc the scheduler verified every stage plan at submission
         # (ballista.tpu.verify_plans) and the executor decodes the very
@@ -67,13 +127,13 @@ class StandaloneCluster:
         # executors keep it: their build may disagree with the
         # scheduler's serde vocabulary.
         executor.verify_decoded_plans = False
-        _svc, flight_port, _t = start_flight_server("127.0.0.1", 0, work_dir)
+        svc, flight_port, _t = start_flight_server("127.0.0.1", 0, work_dir)
         if policy == TaskSchedulingPolicy.PUSH_STAGED:
             from ballista_tpu.executor.executor_server import ExecutorServer
 
             loop = ExecutorServer(
                 executor,
-                f"localhost:{scheduler_port}",
+                f"localhost:{self.scheduler_port}",
                 "localhost",
                 flight_port,
                 task_slots=concurrent_tasks,
@@ -83,33 +143,58 @@ class StandaloneCluster:
         else:
             loop = PollLoop(
                 executor,
-                f"localhost:{scheduler_port}",
+                f"localhost:{self.scheduler_port}",
                 "localhost",
                 flight_port,
                 task_slots=concurrent_tasks,
             )
             loop.start()
-        return cls(
-            scheduler=scheduler,
-            scheduler_grpc=grpc_server,
-            scheduler_port=scheduler_port,
+        handle = ExecutorHandle(
             executor=executor,
-            poll_loop=loop,
+            loop=loop,
+            flight_service=svc,
             flight_port=flight_port,
             work_dir=work_dir,
-            _tmp=tmp,
         )
+        self.executors.append(handle)
+        return handle
+
+    def kill_executor(self, index: int, lose_shuffle: bool = True) -> str:
+        """Chaos primitive: make executor ``index`` die the way a crashed
+        machine does. Stops its task loop (heartbeats/polls cease — the
+        scheduler's expiry sweep will declare it dead), shuts down its
+        Flight server (remote fetches get connection-refused), and with
+        ``lose_shuffle`` deletes its work dir (local-path fetches see the
+        files gone — the lost-shuffle case even when reader and writer
+        share a filesystem). Returns the dead executor's id."""
+        h = self.executors[index]
+        h.alive = False
+        h.loop.stop()
+        try:
+            h.flight_service.shutdown()
+        except Exception:  # noqa: BLE001 — already down
+            pass
+        if lose_shuffle:
+            shutil.rmtree(h.work_dir, ignore_errors=True)
+        return h.executor.executor_id
 
     def attach_provider(self, provider: TableProvider) -> None:
         """Point scheduler planning + executor decode at a shared table
         registry (the reference's client-side registration model)."""
         self.scheduler.provider = provider
         self.scheduler.codec.provider = provider
-        self.executor.provider = provider
-        self.executor.codec.provider = provider
+        for h in self.executors:
+            h.executor.provider = provider
+            h.executor.codec.provider = provider
 
     def stop(self) -> None:
-        self.poll_loop.stop()
+        for h in self.executors:
+            if h.alive:
+                h.loop.stop()
+                try:
+                    h.flight_service.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
         self.scheduler.shutdown()
         self.scheduler_grpc.stop(grace=None)
         self._tmp.cleanup()

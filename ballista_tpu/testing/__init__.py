@@ -1,0 +1,5 @@
+"""Testing utilities shipped with the engine (fault injection).
+
+Importable in production builds but inert unless explicitly enabled; see
+:mod:`ballista_tpu.testing.faults`.
+"""

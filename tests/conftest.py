@@ -18,6 +18,13 @@ import numpy as np
 import pyarrow as pa
 import pytest
 
+# Fault-injection hygiene: a stray BALLISTA_FAULTS in the developer's shell
+# must NOT poison normal test runs (injected crashes would masquerade as
+# real failures). Strip the keys BEFORE CPU_MESH_ENV snapshots os.environ;
+# chaos tests (-m chaos) re-add them to their SUBPROCESS envs explicitly.
+for _k in ("BALLISTA_FAULTS", "BALLISTA_FAULTS_SEED"):
+    os.environ.pop(_k, None)
+
 # Environment for subprocesses that need an 8-device virtual CPU mesh.
 CPU_MESH_ENV = {
     **{k: v for k, v in os.environ.items() if not k.startswith(("PALLAS_AXON", "AXON"))},
@@ -57,6 +64,25 @@ def pytest_runtest_makereport(item, call):
             "@pytest.mark.slow (excluded from the tier-1 gate) or make it "
             "faster; raise BALLISTA_TEST_TIME_LIMIT_S only for slow hosts."
         )
+
+
+@pytest.fixture(autouse=True)
+def _fault_injection_inert():
+    """Guard: fault injection must be OFF in the test-runner process for
+    every test. Chaos tests only enable it inside subprocess environments;
+    if this trips, something leaked BALLISTA_FAULTS into the runner or
+    called faults.install() without cleaning up."""
+    from ballista_tpu.testing import faults
+
+    assert not faults.enabled(), (
+        "fault injection is active in the pytest process; chaos rules must "
+        "only be enabled in subprocess envs (BALLISTA_FAULTS) or torn down "
+        "with faults.install(None)"
+    )
+    yield
+    assert not faults.enabled(), (
+        "test left fault injection installed; call faults.install(None)"
+    )
 
 
 @pytest.fixture(scope="session")

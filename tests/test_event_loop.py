@@ -1,0 +1,72 @@
+"""EventLoop shutdown hygiene.
+
+Regression: ``stop()`` used a BLOCKING ``put(None)`` to wake the consumer;
+with the bounded queue full at shutdown this deadlocked forever (the
+consumer may already have observed _stop and exited, so nothing drains).
+``stop()`` must return promptly regardless of queue state, and the run
+loop must honor _stop between events even when no sentinel arrives.
+"""
+
+import threading
+import time
+
+import ballista_tpu.event_loop as el
+from ballista_tpu.event_loop import EventAction, EventLoop
+
+
+class _Blocking(EventAction):
+    """Blocks the consumer inside on_receive until released."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+
+    def on_receive(self, event):
+        self.entered.set()
+        self.release.wait(timeout=10)
+        return None
+
+
+def test_stop_does_not_deadlock_on_full_queue(monkeypatch):
+    # tiny buffer so the test fills it instantly
+    monkeypatch.setattr(el, "_BUFFER", 4)
+    action = _Blocking()
+    loop = EventLoop("t", action)
+    loop._q.maxsize = 4
+    loop.start()
+    loop.post("wedge")  # consumer blocks inside on_receive
+    assert action.entered.wait(timeout=5)
+    for i in range(4):  # fill the queue while the consumer is stuck
+        loop._q.put_nowait(f"e{i}")
+    t0 = time.time()
+    stopper = threading.Thread(target=loop.stop)
+    stopper.start()
+    # stop() must be blocked ONLY on joining the busy consumer, not on a
+    # queue put; releasing the consumer must let everything finish fast
+    time.sleep(0.1)
+    action.release.set()
+    stopper.join(timeout=10)
+    assert not stopper.is_alive(), "EventLoop.stop() deadlocked"
+    assert time.time() - t0 < 10
+
+
+def test_run_loop_honors_stop_without_sentinel():
+    class _Count(EventAction):
+        def __init__(self):
+            self.n = 0
+
+        def on_receive(self, event):
+            self.n += 1
+            return None
+
+    action = _Count()
+    loop = EventLoop("t2", action)
+    loop.start()
+    loop.post("a")
+    loop.drain()
+    assert action.n == 1
+    # stop with an EMPTY queue: the timed get must notice _stop
+    t0 = time.time()
+    loop.stop()
+    assert time.time() - t0 < 5
+    assert loop._thread is not None and not loop._thread.is_alive()

@@ -1,0 +1,260 @@
+"""Fault-injection harness + bounded-retry wiring, in-process.
+
+The heavyweight multi-executor chaos acceptance lives in
+tests/test_chaos_recovery.py (subprocess, -m chaos); these tests pin the
+harness semantics (deterministic keying, matching, max_fires, env parsing)
+and drive the scheduler's retry machinery through a real standalone
+cluster with faults installed in-proc — torn down before the conftest
+inert-guard checks again.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.errors import (
+    ShuffleFetchError,
+    error_is_retryable,
+    parse_shuffle_fetch_error,
+)
+from ballista_tpu.testing import faults
+from ballista_tpu.testing.faults import (
+    FaultInjector,
+    InjectedFault,
+    InjectedFetchError,
+)
+
+
+# -- injector semantics ------------------------------------------------------
+def test_rule_matching_and_attempt_lists():
+    inj = FaultInjector(
+        [{"point": "task_crash", "stage": 2, "partition": 0, "attempt": [0, 1]}]
+    )
+    with pytest.raises(InjectedFault):
+        inj.on_task_start("j", 2, 0, 0)
+    with pytest.raises(InjectedFault):
+        inj.on_task_start("j", 2, 0, 1)
+    # attempt 2 survives; other stages/partitions never match
+    inj.on_task_start("j", 2, 0, 2)
+    inj.on_task_start("j", 3, 0, 0)
+    inj.on_task_start("j", 2, 1, 0)
+
+
+def test_plan_error_flavor_is_non_retryable_on_the_wire():
+    inj = FaultInjector(
+        [{"point": "task_crash", "error": "plan"}]
+    )
+    from ballista_tpu.errors import PlanVerificationError
+
+    with pytest.raises(PlanVerificationError) as ei:
+        inj.on_task_start("j", 1, 0, 0)
+    wire = f"{type(ei.value).__name__}: {ei.value}"
+    assert not error_is_retryable(wire)
+    # the generic flavor stays retryable
+    inj2 = FaultInjector([{"point": "task_crash"}])
+    with pytest.raises(InjectedFault) as ei2:
+        inj2.on_task_start("j", 1, 0, 0)
+    assert error_is_retryable(f"{type(ei2.value).__name__}: {ei2.value}")
+
+
+def test_max_fires_bounds_rule():
+    inj = FaultInjector([{"point": "fetch_error", "max_fires": 2}])
+    for attempt in range(2):
+        with pytest.raises(InjectedFetchError):
+            inj.on_fetch_attempt("j", 1, 0, attempt)
+    inj.on_fetch_attempt("j", 1, 0, 2)  # budget spent: no fault
+    assert len(inj.log) == 2
+
+
+def test_probabilistic_rules_are_deterministic_per_key():
+    r = [{"point": "fetch_error", "p": 0.5}]
+    a, b = FaultInjector(r, seed=7), FaultInjector(r, seed=7)
+    outcomes_a, outcomes_b = [], []
+    for inj, out in ((a, outcomes_a), (b, outcomes_b)):
+        for part in range(32):
+            try:
+                inj.on_fetch_attempt("j", 1, part, 0)
+                out.append(False)
+            except InjectedFetchError:
+                out.append(True)
+    assert outcomes_a == outcomes_b  # same seed -> same schedule
+    assert any(outcomes_a) and not all(outcomes_a)  # p actually applied
+    c = FaultInjector(r, seed=8)
+    outcomes_c = []
+    for part in range(32):
+        try:
+            c.on_fetch_attempt("j", 1, part, 0)
+            outcomes_c.append(False)
+        except InjectedFetchError:
+            outcomes_c.append(True)
+    assert outcomes_c != outcomes_a  # different seed -> different schedule
+
+
+def test_heartbeat_blackout_matches_executor_prefix():
+    inj = FaultInjector([{"point": "heartbeat_blackout", "executor": "dead*"}])
+    assert inj.heartbeat_suppressed("deadbeef")
+    assert not inj.heartbeat_suppressed("alive01")
+
+
+def test_env_config_roundtrip(monkeypatch):
+    import ballista_tpu.testing.faults as f
+
+    monkeypatch.setattr(f, "_INJECTOR", None)
+    monkeypatch.setattr(f, "_ENV_LOADED", False)
+    monkeypatch.setenv(f.ENV_FAULTS, '[{"point": "task_crash", "stage": 5}]')
+    monkeypatch.setenv(f.ENV_SEED, "11")
+    inj = f.active()
+    assert inj is not None and inj.seed == 11
+    with pytest.raises(InjectedFault):
+        inj.on_task_start("j", 5, 0, 0)
+    # restore the disabled state for the conftest guard
+    f.install(None)
+
+
+def test_unknown_point_rejected():
+    with pytest.raises(ValueError):
+        FaultInjector([{"point": "nonsense"}])
+
+
+# -- error taxonomy ----------------------------------------------------------
+def test_shuffle_fetch_error_wire_roundtrip():
+    e = ShuffleFetchError(
+        "endpoint gone",
+        job_id="jobx",
+        stage_id=3,
+        partition=7,
+        executor_id="exec-9",
+    )
+    wire = f"{type(e).__name__}: {e}\ntraceback junk..."
+    assert error_is_retryable(wire)
+    assert parse_shuffle_fetch_error(wire) == ("jobx", 3, 7, "exec-9")
+    assert parse_shuffle_fetch_error("ValueError: nope") is None
+
+
+# -- scheduler retry wiring through a real standalone cluster ----------------
+def _run_grouped_query(ctx):
+    n = 4000
+    r = np.random.default_rng(3)
+    t = pa.table({
+        "k": pa.array(r.integers(0, 23, n)),
+        "v": pa.array(r.uniform(0, 10, n)),
+    })
+    ctx.register_table("t", t)
+    got = ctx.sql(
+        "select k, sum(v) as sv, count(*) as n from t group by k order by k"
+    ).collect().to_pandas()
+    df = t.to_pandas()
+    want = (
+        df.groupby("k").agg(sv=("v", "sum"), n=("v", "count"))
+        .reset_index().sort_values("k").reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(got.k, want.k)
+    np.testing.assert_array_equal(got.n, want.n)
+    np.testing.assert_allclose(got.sv, want.sv, rtol=1e-9)
+
+
+def test_bounded_retry_recovers_injected_crash():
+    """A task that crashes on its first attempt is requeued
+    (FAILED -> PENDING) and succeeds on the retry; results are intact and
+    the retry is visible on the job."""
+    from ballista_tpu.client.context import BallistaContext
+
+    faults.install(
+        [{"point": "task_crash", "partition": 0, "attempt": 0,
+          "max_fires": 1}]
+    )
+    try:
+        ctx = BallistaContext.standalone()
+        try:
+            _run_grouped_query(ctx)
+            sched = ctx._standalone_cluster.scheduler
+            job = next(iter(sched.jobs.values()))
+            assert job.status == "completed"
+            assert job.total_retries >= 1
+        finally:
+            ctx.close()
+    finally:
+        faults.install(None)
+
+
+def test_retry_exhaustion_fails_job_with_injected_error():
+    """task_max_attempts=1: the first failure is final and the injected
+    error surfaces in JobStatus."""
+    from ballista_tpu.client.context import BallistaContext
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.errors import BallistaError
+
+    faults.install([{"point": "task_crash", "partition": 0}])
+    try:
+        cfg = BallistaConfig().with_setting(
+            "ballista.tpu.task_max_attempts", "1"
+        )
+        ctx = BallistaContext.standalone(cfg)
+        try:
+            with pytest.raises(BallistaError, match="injected task crash"):
+                _run_grouped_query(ctx)
+            sched = ctx._standalone_cluster.scheduler
+            job = next(iter(sched.jobs.values()))
+            assert job.status == "failed"
+            assert "injected task crash" in job.error
+            assert job.total_retries == 0
+        finally:
+            ctx.close()
+    finally:
+        faults.install(None)
+
+
+def test_deterministic_plan_error_short_circuits_without_retries():
+    """An executor-side PlanVerificationError must fail the job on the
+    FIRST attempt even though 3 attempts are allowed."""
+    from ballista_tpu.client.context import BallistaContext
+    from ballista_tpu.errors import BallistaError
+
+    faults.install(
+        [{"point": "task_crash", "partition": 0, "error": "plan"}]
+    )
+    try:
+        ctx = BallistaContext.standalone()
+        try:
+            with pytest.raises(BallistaError, match="injected deterministic"):
+                _run_grouped_query(ctx)
+            sched = ctx._standalone_cluster.scheduler
+            job = next(iter(sched.jobs.values()))
+            assert job.status == "failed"
+            assert job.total_retries == 0, (
+                "deterministic errors must not consume retries"
+            )
+        finally:
+            ctx.close()
+    finally:
+        faults.install(None)
+
+
+def test_injected_fetch_faults_absorbed_by_retry_budget():
+    """Two injected fetch failures on one shuffle partition are retried
+    transparently inside the fetch layer — the query completes with ZERO
+    task-level retries."""
+    from ballista_tpu.client.context import BallistaContext
+    from ballista_tpu.config import BallistaConfig
+
+    faults.install(
+        [{"point": "fetch_error", "attempt": [0, 1]}]
+    )
+    try:
+        cfg = BallistaConfig().with_setting(
+            "ballista.tpu.fetch_backoff_ms", "5"
+        )
+        ctx = BallistaContext.standalone(cfg)
+        try:
+            _run_grouped_query(ctx)
+            sched = ctx._standalone_cluster.scheduler
+            job = next(iter(sched.jobs.values()))
+            assert job.status == "completed"
+            inj = faults.active()
+            assert any(p == "fetch_error" for p, _ in inj.log), (
+                "fetch faults never fired — injection point unwired?"
+            )
+        finally:
+            ctx.close()
+    finally:
+        faults.install(None)

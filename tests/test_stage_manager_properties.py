@@ -11,10 +11,12 @@ import random
 import threading
 
 from ballista_tpu.scheduler.stage_manager import (
+    _LEGAL,
     JobFailed,
     JobFinished,
     StageFinished,
     StageManager,
+    TaskRescheduled,
     TaskState,
 )
 from ballista_tpu.scheduler_types import PartitionId
@@ -148,6 +150,187 @@ def test_remove_job_stages_clears_everything():
     assert not sm.is_pending_stage("a", 2)
     assert sm.inflight_tasks() == 1  # job b untouched
     assert sm.fetch_schedulable_stage() == ("b", 1)
+
+
+def _observed_states(stage):
+    return [t.state for t in stage.tasks]
+
+
+def test_retry_cycle_attempts_bounded_and_exhaustion_fails():
+    """Property: under random RUNNING/FAILED/COMPLETED/reset interleavings
+    with bounded retries, (1) attempts never exceed the cap, (2) a
+    retryable failure below the cap always requeues (TaskRescheduled, task
+    PENDING), (3) reaching the cap always yields JobFailed, and (4) every
+    state change the machine takes is a legal transition."""
+    rng = random.Random(23)
+    for trial in range(60):
+        sm = StageManager()
+        n_tasks = rng.randint(1, 5)
+        cap = rng.randint(1, 4)
+        sm.add_running_stage("job", 1, n_tasks, max_attempts=cap)
+        sm.add_final_stage("job", 1)
+        stage = sm.get_stage("job", 1)
+        failed_jobs = 0
+        for _ in range(rng.randint(10, 60)):
+            pid = PartitionId("job", 1, rng.randrange(n_tasks))
+            op = rng.random()
+            before = _observed_states(stage)
+            if op < 0.35:
+                events = sm.update_task_status(
+                    pid, TaskState.RUNNING, executor_id=f"e{rng.randrange(3)}"
+                )
+            elif op < 0.7:
+                events = sm.update_task_status(
+                    pid, TaskState.FAILED,
+                    executor_id=f"e{rng.randrange(3)}", error="boom",
+                )
+            elif op < 0.85:
+                events = sm.update_task_status(
+                    pid, TaskState.COMPLETED, executor_id="e0"
+                )
+            else:
+                reset = sm.reset_tasks_of_executors({f"e{rng.randrange(3)}"})
+                events = []
+                for rpid in reset:
+                    # executor-lost resets never consume attempts
+                    assert stage.tasks[rpid.partition_id].state == (
+                        TaskState.PENDING
+                    )
+            after = _observed_states(stage)
+            for b, a in zip(before, after):
+                if b != a:
+                    # every observable hop is legal; the FAILED->PENDING
+                    # requeue collapses two legal hops into one update
+                    assert (b, a) in _LEGAL or (
+                        (b, TaskState.FAILED) in _LEGAL
+                        and (TaskState.FAILED, a) in _LEGAL
+                    ), (b, a)
+            for e in events:
+                if isinstance(e, TaskRescheduled):
+                    t = stage.tasks[e.partition_id]
+                    assert e.attempt <= cap - 1, "requeue at/past the cap"
+                    assert t.state == TaskState.PENDING
+                if isinstance(e, JobFailed):
+                    failed_jobs += 1
+            for t in stage.tasks:
+                assert t.attempts <= cap, (t.attempts, cap)
+            assert sum(stage.counts().values()) == n_tasks
+        # exhaustion check: drive one task to the cap deterministically
+        sm2 = StageManager()
+        sm2.add_running_stage("j2", 1, 1, max_attempts=cap)
+        sm2.add_final_stage("j2", 1)
+        pid = PartitionId("j2", 1, 0)
+        seen_failed = False
+        for attempt in range(cap):
+            sm2.update_task_status(pid, TaskState.RUNNING, executor_id="e")
+            events = sm2.update_task_status(
+                pid, TaskState.FAILED, executor_id="e", error="boom"
+            )
+            if attempt < cap - 1:
+                assert [type(e) for e in events] == [TaskRescheduled]
+            else:
+                assert [type(e) for e in events] == [JobFailed]
+                seen_failed = True
+        assert seen_failed
+        task = sm2.get_stage("j2", 1).tasks[0]
+        assert task.attempts == cap
+        assert task.state == TaskState.FAILED
+
+
+def test_non_retryable_failure_short_circuits():
+    sm = StageManager()
+    sm.add_running_stage("j", 1, 2, max_attempts=5)
+    pid = PartitionId("j", 1, 0)
+    sm.update_task_status(pid, TaskState.RUNNING, executor_id="e")
+    events = sm.update_task_status(
+        pid, TaskState.FAILED, executor_id="e",
+        error="PlanVerificationError: boom", retryable=False,
+    )
+    assert [type(e) for e in events] == [JobFailed]
+    t = sm.get_stage("j", 1).tasks[0]
+    assert t.state == TaskState.FAILED and t.attempts == 1
+
+
+def test_fetch_failure_requeue_skips_attempt_charge():
+    sm = StageManager()
+    sm.add_running_stage("j", 1, 1, max_attempts=2)
+    pid = PartitionId("j", 1, 0)
+    for _ in range(5):  # would exhaust max_attempts=2 if counted
+        sm.update_task_status(pid, TaskState.RUNNING, executor_id="e")
+        events = sm.update_task_status(
+            pid, TaskState.FAILED, executor_id="e",
+            error="ShuffleFetchError: lost", count_attempt=False,
+        )
+        assert [type(e) for e in events] == [TaskRescheduled]
+    assert sm.get_stage("j", 1).tasks[0].attempts == 0
+
+
+def test_blame_prefers_other_executor_but_never_starves():
+    sm = StageManager()
+    sm.add_running_stage("j", 1, 2, max_attempts=3)
+    pid = PartitionId("j", 1, 0)
+    sm.update_task_status(pid, TaskState.RUNNING, executor_id="bad")
+    sm.update_task_status(pid, TaskState.FAILED, executor_id="bad", error="x")
+    # task 0 blames "bad": for "bad" the un-blamed task 1 sorts first...
+    assert sm.fetch_pending_tasks("j", 1, 2, executor_id="bad") == [1, 0]
+    # ...for anyone else natural order stands
+    assert sm.fetch_pending_tasks("j", 1, 2, executor_id="good") == [0, 1]
+    # and with only the blamed task left, "bad" still gets it (no
+    # starvation on a one-executor cluster)
+    sm.update_task_status(
+        PartitionId("j", 1, 1), TaskState.RUNNING, executor_id="bad"
+    )
+    assert sm.fetch_pending_tasks("j", 1, 1, executor_id="bad") == [0]
+
+
+def test_invalidate_executor_outputs_reopens_and_rolls_back():
+    sm = StageManager()
+    sm.add_running_stage("j", 1, 2, max_attempts=3)
+    sm.add_final_stage("j", 9)  # stage 1 is NOT final
+    for i, eid in enumerate(["dead", "alive"]):
+        pid = PartitionId("j", 1, i)
+        sm.update_task_status(pid, TaskState.RUNNING, executor_id=eid)
+        sm.update_task_status(
+            pid, TaskState.COMPLETED, executor_id=eid, partitions=[]
+        )
+    assert sm.is_completed_stage("j", 1)
+    reopened = sm.invalidate_executor_outputs("j", 1, {"dead"})
+    assert reopened == [PartitionId("j", 1, 0)]
+    # stage rolled back to running; only the lost partition re-runs
+    assert sm.is_running_stage("j", 1) and not sm.is_completed_stage("j", 1)
+    tasks = sm.get_stage("j", 1).tasks
+    assert tasks[0].state == TaskState.PENDING and "dead" in tasks[0].blamed
+    assert tasks[1].state == TaskState.COMPLETED
+    assert sm.stage_recomputes("j", 1) == 1
+    # second invalidation of the same executor: nothing left to re-open
+    assert sm.invalidate_executor_outputs("j", 1, {"dead"}) == []
+    assert sm.stage_recomputes("j", 1) == 1
+    # completing the lost partition again re-completes the stage
+    pid = PartitionId("j", 1, 0)
+    sm.update_task_status(pid, TaskState.RUNNING, executor_id="alive")
+    events = sm.update_task_status(
+        pid, TaskState.COMPLETED, executor_id="alive", partitions=[]
+    )
+    assert [type(e) for e in events] == [StageFinished]
+    assert sm.is_completed_stage("j", 1)
+
+
+def test_promote_pending_stage_fires_completion_events():
+    """A stage demoted during recovery whose in-flight tasks then all
+    complete must emit its completion events at promotion time."""
+    sm = StageManager()
+    sm.add_running_stage("j", 1, 1)
+    sm.add_final_stage("j", 1)
+    pid = PartitionId("j", 1, 0)
+    sm.update_task_status(pid, TaskState.RUNNING, executor_id="e")
+    sm.demote_running_stage("j", 1)
+    # completes while pending: no event can fire yet (stage not running)
+    assert sm.update_task_status(
+        pid, TaskState.COMPLETED, executor_id="e", partitions=[]
+    ) == []
+    events = sm.promote_pending_stage("j", 1)
+    assert [type(e) for e in events] == [JobFinished]
+    assert sm.is_completed_stage("j", 1)
 
 
 def test_job_stage_summary_snapshot():
