@@ -1,7 +1,8 @@
-"""Static analysis subsystem (``planlint``).
+"""Static analysis subsystem (``planlint``/``racelint``).
 
-Three passes that move whole classes of executor-runtime failures to
-submission/collection time:
+Four passes that move whole classes of executor-runtime failures to
+submission/collection time — run together via
+``python -m ballista_tpu.analysis``:
 
 - :mod:`ballista_tpu.analysis.verifier` — pre-execution plan verification
   (schema agreement, column resolution, TPU dtype legality, shuffle
@@ -14,6 +15,13 @@ submission/collection time:
   (tracer branching, host sync inside jit, missing static_argnames,
   dynamic-shape primitives) over ``ops/`` and ``exec/``, plus a per-kernel
   static signature report.
+- :mod:`ballista_tpu.analysis.racelint` — lock-discipline + state-machine
+  lint over the concurrent control plane (guarded-field inference,
+  lock-order cycles, blocking-under-lock, declared status transitions),
+  with the canonical transition tables in
+  :mod:`ballista_tpu.analysis.statemachine` and a runtime lock-order
+  witness in :mod:`ballista_tpu.analysis.witness`
+  (``BALLISTA_LOCK_WITNESS=1``).
 """
 
 from ballista_tpu.errors import PlanVerificationError  # noqa: F401

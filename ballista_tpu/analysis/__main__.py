@@ -1,0 +1,171 @@
+"""Combined static-analysis gate: ``python -m ballista_tpu.analysis``.
+
+Runs all four analyzers with one exit code and a per-analyzer summary
+line — the single command CI (and a developer pre-push) needs:
+
+- **planlint** — the plan verifier over the TPC-H q1-q22 corpus
+  (logical + physical tiers, plus distributed stage DAGs for a
+  representative mix), proving the verifier still accepts every plan the
+  engine produces.
+- **serde-audit** — structural closure of the proto vocabulary
+  (round-trip byte stability or written exemption for every node class).
+- **jaxlint** — JAX/TPU hazard lint over ``ops/`` + ``exec/``.
+- **racelint** — lock-discipline + state-machine lint over the
+  concurrent control plane (suppression budget enforced here too).
+
+Flags: ``--dot`` prints the racelint lock-order graph (Graphviz) and
+exits; ``--tables`` prints the canonical status state machines and
+exits; ``--skip a,b`` / ``--only a,b`` select analyzers;
+``--queries 1,3,6`` limits planlint's TPC-H corpus (tier-1 runs a
+subset — the full corpus is covered by tests/test_plan_verifier.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+ANALYZERS = ("planlint", "serde-audit", "jaxlint", "racelint")
+
+
+def run_planlint(queries=None) -> tuple[bool, str]:
+    import pathlib
+
+    from ballista_tpu.analysis import (
+        verify_logical,
+        verify_physical,
+        verify_stages,
+    )
+    from ballista_tpu.distributed_plan import DistributedPlanner
+    from ballista_tpu.exec.context import TpuContext
+    from ballista_tpu.exec.planner import PhysicalPlanner
+    from ballista_tpu.plan.optimizer import optimize
+    from ballista_tpu.tpch import gen_all
+
+    qdir = (
+        pathlib.Path(__file__).resolve().parents[2]
+        / "benchmarks" / "queries"
+    )
+    ctx = TpuContext()
+    for name, tab in gen_all(scale=0.001).items():
+        ctx.register_table(name, tab)
+    qs = list(queries) if queries else list(range(1, 23))
+    checks = 0
+    for i in qs:
+        sql = (qdir / f"q{i}.sql").read_text()
+        optimized = optimize(ctx.sql_to_logical(sql))
+        checks += verify_logical(optimized, sql=sql).checks
+        phys = ctx.create_physical_plan(optimized, sql=sql)
+        checks += verify_physical(phys, sql=sql).checks
+        dist = PhysicalPlanner(
+            ctx, 2, config=ctx.config, distributed=True
+        ).plan(optimized)
+        stages = DistributedPlanner().plan_query_stages(f"job-q{i}", dist)
+        checks += verify_stages(stages, sql=sql).checks
+    return True, f"{len(qs)} TPC-H queries verified ({checks} checks)"
+
+
+def run_serde_audit() -> tuple[bool, str]:
+    from ballista_tpu.analysis.serde_audit import (
+        audit_expressions,
+        audit_logical,
+        audit_physical,
+    )
+
+    results = [audit_expressions(), audit_logical(), audit_physical()]
+    ok = all(r.ok for r in results)
+    return ok, "; ".join(r.summary() for r in results)
+
+
+def run_jaxlint() -> tuple[bool, str]:
+    from ballista_tpu.analysis import jaxlint
+
+    diags = jaxlint.lint_paths()
+    sup = jaxlint.suppression_count()
+    if diags:
+        return False, "\n".join(str(d) for d in diags)
+    if sup > 5:
+        return False, f"suppression budget exceeded: {sup} > 5"
+    return True, f"0 hazards, {sup} suppressions"
+
+
+def run_racelint() -> tuple[bool, str]:
+    from ballista_tpu.analysis import racelint
+
+    analysis = racelint.analyze()  # one parse+fixpoint for all three views
+    diags = analysis.diagnostics()
+    sup = analysis.suppression_count()
+    edges = analysis.lock_edges()
+    if diags:
+        return False, "\n".join(str(d) for d in diags)
+    if sup > 5:
+        return False, f"suppression budget exceeded: {sup} > 5"
+    return True, (
+        f"0 findings, {sup} suppressions, lock-order graph: "
+        f"{len(edges)} edges, acyclic"
+    )
+
+
+def run_all(
+    skip=(), only=(), queries=None, out=print
+) -> int:
+    """Run the selected analyzers; returns the process exit code."""
+    runners = {
+        "planlint": lambda: run_planlint(queries),
+        "serde-audit": run_serde_audit,
+        "jaxlint": run_jaxlint,
+        "racelint": run_racelint,
+    }
+    failed = []
+    for name in ANALYZERS:
+        if name in skip or (only and name not in only):
+            out(f"{name}: SKIPPED")
+            continue
+        try:
+            ok, summary = runners[name]()
+        except Exception as e:  # noqa: BLE001 — an analyzer crash is a fail
+            ok, summary = False, f"analyzer crashed: {type(e).__name__}: {e}"
+        out(f"{name}: {'OK' if ok else 'FAIL'} — {summary}")
+        if not ok:
+            failed.append(name)
+    if failed:
+        out(f"FAILED: {', '.join(failed)}")
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m ballista_tpu.analysis")
+    ap.add_argument("--skip", default="", help="comma-separated analyzers")
+    ap.add_argument("--only", default="", help="comma-separated analyzers")
+    ap.add_argument(
+        "--queries", default="",
+        help="comma-separated TPC-H query numbers for planlint",
+    )
+    ap.add_argument(
+        "--dot", action="store_true",
+        help="print the racelint lock-order graph (Graphviz) and exit",
+    )
+    ap.add_argument(
+        "--tables", action="store_true",
+        help="print the canonical status state machines and exit",
+    )
+    args = ap.parse_args(argv)
+    if args.dot:
+        from ballista_tpu.analysis import racelint
+
+        print(racelint.lock_order_dot())
+        return 0
+    if args.tables:
+        from ballista_tpu.analysis.statemachine import render_tables
+
+        print(render_tables())
+        return 0
+    skip = tuple(s for s in args.skip.split(",") if s)
+    only = tuple(s for s in args.only.split(",") if s)
+    queries = [int(q) for q in args.queries.split(",") if q] or None
+    return run_all(skip=skip, only=only, queries=queries)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,12 +28,12 @@ from __future__ import annotations
 
 import contextlib
 import hashlib
-import threading
 import time
 
 import pyarrow as pa
 import pyarrow.flight as paflight
 
+from ballista_tpu.analysis.witness import make_lock
 from ballista_tpu.config import BallistaConfig
 from ballista_tpu.errors import ShuffleFetchError
 from ballista_tpu.proto import pb
@@ -60,19 +60,35 @@ _TRANSIENT_FLIGHT_ERRORS = (
 )
 
 _POOL: dict[tuple[str, int], paflight.FlightClient] = {}
-_POOL_LOCK = threading.Lock()
+_POOL_LOCK = make_lock("flight._POOL_LOCK")
 
 
 def _client_for(host: str, port: int) -> paflight.FlightClient:
     """Cached Flight connection per (host, port). Arrow's FlightClient is
-    thread-safe; concurrent shuffle readers share one channel per peer."""
+    thread-safe; concurrent shuffle readers share one channel per peer.
+
+    The dial happens OUTSIDE the pool lock (racelint blocking-under-lock):
+    a slow handshake toward one dead peer must not serialize every other
+    fetch thread — across healthy peers — behind the global lock. Two
+    threads racing the first dial both connect; the loser's channel is
+    closed (nobody else can have seen it)."""
     key = (host, port)
     with _POOL_LOCK:
         client = _POOL.get(key)
-        if client is None:
-            client = paflight.connect(f"grpc://{host}:{port}")
-            _POOL[key] = client
+    if client is not None:
         return client
+    client = paflight.connect(f"grpc://{host}:{port}")
+    extra = None
+    with _POOL_LOCK:
+        raced = _POOL.get(key)
+        if raced is not None:
+            client, extra = raced, client
+        else:
+            _POOL[key] = client
+    if extra is not None:
+        with contextlib.suppress(Exception):
+            extra.close()
+    return client
 
 
 def _evict(host: str, port: int, client: paflight.FlightClient) -> None:

@@ -5,10 +5,19 @@ ref ballista/rust/core/src/event_loop.rs:27-141 — ``EventAction<E>`` trait
 Thread-based here (the gRPC servicers are thread-driven); the single
 consumer thread gives the same data-race freedom the reference gets from
 the tokio mpsc single-receiver.
+
+Full-queue discipline (racelint blocking-under-lock / self-deadlock):
+producers on FOREIGN threads block on the bounded queue (backpressure).
+The CONSUMER thread must never block on its own queue — nothing else
+drains it — so events it posts (handler posts, on_receive follow-ups)
+spill into an unbounded overflow deque drained before the next queue
+get. Nothing is ever dropped: a dropped terminal event (``JobFailed``)
+would wedge its job in "running" forever.
 """
 
 from __future__ import annotations
 
+import collections
 import logging
 import queue
 import threading
@@ -40,6 +49,14 @@ class EventLoop:
         self.name = name
         self.action = action
         self._q: queue.Queue = queue.Queue(maxsize=_BUFFER)
+        # consumer-thread posts that found the queue full; only the
+        # consumer thread itself appends/pops, so no lock is needed
+        self._overflow: collections.deque = collections.deque()
+        # True while the consumer is INSIDE a handler for an
+        # overflow-sourced event — such events are counted by neither
+        # unfinished_tasks nor _overflow, and drain() must not return
+        # while one is mid-flight
+        self._overflow_busy = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -68,6 +85,18 @@ class EventLoop:
         self.action.on_stop()
 
     def post(self, event) -> None:
+        """Enqueue an event. Foreign threads block when the queue is full
+        (backpressure against producers). The CONSUMER thread itself —
+        handlers posting follow-on events — must never block (a
+        guaranteed self-deadlock: nothing else drains the queue), so its
+        posts spill to the unbounded overflow deque instead; terminal
+        events like JobFailed are never dropped."""
+        if threading.current_thread() is self._thread:
+            try:
+                self._q.put_nowait(event)
+            except queue.Full:
+                self._overflow.append(event)
+            return
         self._q.put(event)
 
     def drain(self, timeout: float = 5.0) -> None:
@@ -76,18 +105,29 @@ class EventLoop:
 
         deadline = time.time() + timeout
         while time.time() < deadline:
-            if self._q.unfinished_tasks == 0:
+            if (
+                self._q.unfinished_tasks == 0
+                and not self._overflow
+                and not self._overflow_busy
+            ):
                 return
             time.sleep(0.01)
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            # timed get: honor _stop between events even when no sentinel
-            # ever arrives (stop() with a full queue cannot enqueue one)
-            try:
-                event = self._q.get(timeout=0.2)
-            except queue.Empty:
-                continue
+            from_queue = False
+            if self._overflow:
+                self._overflow_busy = True
+                event = self._overflow.popleft()
+            else:
+                # timed get: honor _stop between events even when no
+                # sentinel ever arrives (stop() with a full queue cannot
+                # enqueue one)
+                try:
+                    event = self._q.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                from_queue = True
             try:
                 if event is None:
                     continue
@@ -98,16 +138,14 @@ class EventLoop:
                     follow_up = None
                 if follow_up is not None:
                     # never block the consumer on its own full queue (a
-                    # self-deadlock: nothing else drains it); dropping a
-                    # follow-up under a 10000-event backlog is the lesser
-                    # evil and is loudly logged
+                    # self-deadlock: nothing else drains it); overflow
+                    # keeps the follow-up instead of dropping it
                     try:
                         self._q.put_nowait(follow_up)
                     except queue.Full:
-                        log.error(
-                            "event loop %s: queue full, dropping follow-up "
-                            "%r", self.name, follow_up,
-                        )
-                    # account for the extra unfinished task we just created
+                        self._overflow.append(follow_up)
             finally:
-                self._q.task_done()
+                if from_queue:
+                    self._q.task_done()
+                else:
+                    self._overflow_busy = False

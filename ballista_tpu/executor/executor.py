@@ -225,6 +225,14 @@ class PollLoop:
     def run(self) -> None:
         channel = grpc.insecure_channel(self.scheduler_addr)
         stub = scheduler_stub(channel)
+        try:
+            self._poll(stub)
+        finally:
+            # the channel owns sockets and callback threads; a stopped
+            # loop that abandons it leaks them across start/stop cycles
+            channel.close()
+
+    def _poll(self, stub) -> None:
         while not self._stop.is_set():
             from ballista_tpu.testing import faults
 

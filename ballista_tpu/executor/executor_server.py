@@ -197,10 +197,27 @@ class ExecutorServer:
                 log.warning("UpdateTaskStatus failed: %s", e)
 
     def stop(self) -> None:
+        """Graceful drain: signal, then JOIN the heartbeater and every
+        runner thread before tearing down the gRPC surface — abandoned
+        daemon threads would leak across start/stop cycles and could
+        race a half-closed channel with their final UpdateTaskStatus."""
         self._stop.set()
+        stragglers = []
         for t in self._threads:
             t.join(timeout=5)
+            if t.is_alive():
+                stragglers.append(t.name)
         if self._grpc_server is not None:
-            self._grpc_server.stop(grace=None)
-        if self._channel is not None:
+            ev = self._grpc_server.stop(grace=None)
+            if ev is not None:
+                ev.wait(timeout=5)
+        if stragglers:
+            # a runner still draining a long task would race a closed
+            # channel with its final UpdateTaskStatus — leave the channel
+            # to GC and make the leak loud instead of silent
+            log.warning(
+                "executor stop: threads outlived the join timeout: %s; "
+                "leaving the scheduler channel open for them", stragglers,
+            )
+        elif self._channel is not None:
             self._channel.close()

@@ -5,9 +5,9 @@ ref ballista/rust/scheduler/src/state/executor_manager.rs:28-145.
 
 from __future__ import annotations
 
-import threading
 import time
 
+from ballista_tpu.analysis.witness import make_lock
 from ballista_tpu.scheduler_types import ExecutorData, ExecutorMetadata
 
 DEFAULT_EXECUTOR_TIMEOUT_SECONDS = 60.0  # ref :69-77
@@ -15,7 +15,7 @@ DEFAULT_EXECUTOR_TIMEOUT_SECONDS = 60.0  # ref :69-77
 
 class ExecutorManager:
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = make_lock("ExecutorManager._lock", reentrant=True)
         self._heartbeats: dict[str, float] = {}
         self._metadata: dict[str, ExecutorMetadata] = {}
         self._data: dict[str, ExecutorData] = {}

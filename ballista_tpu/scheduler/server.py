@@ -20,6 +20,7 @@ import random
 import string
 import threading
 
+from ballista_tpu.analysis.witness import make_lock
 from ballista_tpu.config import BallistaConfig, TaskSchedulingPolicy
 from ballista_tpu.distributed_plan import (
     DistributedPlanner,
@@ -208,7 +209,7 @@ class SchedulerServer:
         # otherwise soak offers forever
         self._launch_failures: dict[str, int] = {}
         self.max_launch_failures = 3
-        self._lock = threading.RLock()
+        self._lock = make_lock("SchedulerServer._lock", reentrant=True)
         self.state = None
         if state_backend is not None:
             from ballista_tpu.scheduler.persistent_state import (
@@ -281,10 +282,11 @@ class SchedulerServer:
                 for c in consumers
             ):
                 continue
-            if not consumers and self.jobs.get(job_id) is not None:
+            job = self._get_job(job_id)
+            if not consumers and job is not None:
                 # final stage of a still-running job: its output is the
                 # job result the client fetches — recompute it too
-                if self.jobs[job_id].final_stage_id != stage_id:
+                if job.final_stage_id != stage_id:
                     continue
             for eid in sorted(expired):
                 if self._on_shuffle_lost(job_id, stage_id, eid):
@@ -295,51 +297,68 @@ class SchedulerServer:
             self.event_loop.post(ReviveOffers())
         return sorted(expired)
 
+    # -- locked accessors (racelint unguarded-field discipline) --------------
+    def _get_job(self, job_id: str) -> JobInfo | None:
+        """``self.jobs`` is written under ``_lock`` (submission, recovery);
+        every cross-thread read goes through here. Also closes the
+        teardown race: a job removed between a stage pick and its use now
+        surfaces as ``None`` instead of a ``KeyError``."""
+        with self._lock:
+            return self.jobs.get(job_id)
+
+    def _session_config(self, session_id: str) -> BallistaConfig:
+        with self._lock:
+            return self.sessions.get(session_id, self.config)
+
     def _recover_state(self) -> None:
         """Rebuild in-memory state from the backend on restart (ref
-        persistent_state.rs init :85-181)."""
-        for em in self.state.load_executors():
-            self.executor_manager.save_executor_metadata(em)
-        for sid, settings in self.state.load_sessions().items():
-            try:
-                self.sessions[sid] = (
-                    BallistaConfig(settings) if settings else self.config
+        persistent_state.rs init :85-181). Runs under the lock: it is
+        called from ``__init__`` today, but it writes the same maps the
+        gRPC threads read, and the lock keeps that true if recovery is
+        ever re-run live."""
+        with self._lock:
+            for em in self.state.load_executors():
+                self.executor_manager.save_executor_metadata(em)
+            for sid, settings in self.state.load_sessions().items():
+                try:
+                    self.sessions[sid] = (
+                        BallistaConfig(settings) if settings else self.config
+                    )
+                except Exception:  # noqa: BLE001 — stale/unknown keys
+                    self.sessions[sid] = self.config
+            for rec in self.state.load_jobs():
+                job = JobInfo(
+                    job_id=rec["job_id"],
+                    session_id=rec["session_id"],
+                    status=rec["status"],
+                    error=rec.get("error", ""),
+                    final_stage_id=rec.get("final_stage_id", 0),
                 )
-            except Exception:  # noqa: BLE001 — stale/unknown keys
-                self.sessions[sid] = self.config
-        for rec in self.state.load_jobs():
-            job = JobInfo(
-                job_id=rec["job_id"],
-                session_id=rec["session_id"],
-                status=rec["status"],
-                error=rec.get("error", ""),
-                final_stage_id=rec.get("final_stage_id", 0),
-            )
-            job.dependencies = {
-                int(k): set(v)
-                for k, v in rec.get("dependencies", {}).items()
-            }
-            job.completed_locations = self.state.locations_from_json(
-                rec.get("locations", [])
-            )
-            plans = self.state.load_stage_plans(job.job_id)
-            for stage_id, plan in plans.items():
-                job.stages[stage_id] = QueryStage(
-                    job.job_id, stage_id, plan
+                job.dependencies = {
+                    int(k): set(v)
+                    for k, v in rec.get("dependencies", {}).items()
+                }
+                job.completed_locations = self.state.locations_from_json(
+                    rec.get("locations", [])
                 )
-            if job.status in ("queued", "running"):
-                # tasks in flight died with the old scheduler; fail loudly
-                # rather than dangle (running StageManager state is not
-                # persisted, matching the reference)
-                job.status = "failed"
-                job.error = "scheduler restarted while job was in flight"
-                self.state.save_job(job)
-            self.jobs[job.job_id] = job
-        if self.jobs:
-            log.info(
-                "recovered %d jobs, %d sessions from state backend",
-                len(self.jobs), len(self.sessions),
-            )
+                plans = self.state.load_stage_plans(job.job_id)
+                for stage_id, plan in plans.items():
+                    job.stages[stage_id] = QueryStage(
+                        job.job_id, stage_id, plan
+                    )
+                if job.status in ("queued", "running"):
+                    # tasks in flight died with the old scheduler; fail
+                    # loudly rather than dangle (running StageManager state
+                    # is not persisted, matching the reference)
+                    job.status = "failed"
+                    job.error = "scheduler restarted while job was in flight"
+                    self.state.save_job(job)
+                self.jobs[job.job_id] = job
+            if self.jobs:
+                log.info(
+                    "recovered %d jobs, %d sessions from state backend",
+                    len(self.jobs), len(self.sessions),
+                )
 
     # -- session management (ref grpc.rs:350-374) ----------------------------
     def get_or_create_session(
@@ -378,7 +397,7 @@ class SchedulerServer:
         return self.submit_logical(logical, session_id)
 
     def submit_logical(self, logical, session_id: str) -> str:
-        cfg = self.sessions.get(session_id, self.config)
+        cfg = self._session_config(session_id)
         optimized = optimize(logical)
         verify = cfg.verify_plans()
         if verify:
@@ -440,12 +459,13 @@ class SchedulerServer:
 
     # -- stage generation (ref query_stage_scheduler.rs:59-105) --------------
     def _generate_stages(self, job_id: str, plan: ExecutionPlan) -> None:
+        job = self._get_job(job_id)
+        if job is None:
+            return
         try:
             planner = DistributedPlanner()
             stages = planner.plan_query_stages(job_id, plan)
-            cfg = self.sessions.get(
-                self.jobs[job_id].session_id, self.config
-            )
+            cfg = self._session_config(job.session_id)
             if cfg.verify_plans():
                 # stage-DAG well-formedness: every UnresolvedShuffleExec
                 # placeholder must agree with its writer stage on schema
@@ -458,7 +478,6 @@ class SchedulerServer:
         except Exception as e:  # noqa: BLE001
             self._on_job_failed(job_id, f"planning failed: {e}")
             return
-        job = self.jobs[job_id]
         job.max_attempts = cfg.task_max_attempts()
         deps: dict[int, set[int]] = {}
         for stage in stages:
@@ -491,7 +510,9 @@ class SchedulerServer:
             job_id, stage_id
         ) or self.stage_manager.is_pending_stage(job_id, stage_id):
             return
-        job = self.jobs[job_id]
+        job = self._get_job(job_id)
+        if job is None:
+            return
         stage = job.stages[stage_id]
         unresolved = find_unresolved_shuffles(stage.plan)
         unfinished = [
@@ -519,7 +540,9 @@ class SchedulerServer:
         unresolved template: lost-shuffle recovery re-invokes this after an
         upstream recompute, and re-resolution needs the placeholders a
         destructive patch would have consumed."""
-        job = self.jobs[job_id]
+        job = self._get_job(job_id)
+        if job is None:
+            raise PlanError(f"job {job_id} torn down during stage resolution")
         stage = job.stages[stage_id]
         unresolved = find_unresolved_shuffles(stage.plan)
         plan = stage.plan
@@ -565,27 +588,43 @@ class SchedulerServer:
         lost-shuffle recompute: their cached plan bytes were invalidated,
         and the pristine template re-resolves against the refreshed
         locations."""
-        job = self.jobs.get(job_id)
+        job = self._get_job(job_id)
         if job is None:
             return
+        deferred: list = []
         for parent in self.stage_manager.parents_of(job_id, stage_id):
-            if not self.stage_manager.is_pending_stage(job_id, parent):
-                continue
-            unresolved = find_unresolved_shuffles(job.stages[parent].plan)
-            if all(
-                self.stage_manager.is_completed_stage(job_id, u.stage_id)
-                for u in unresolved
-            ):
-                self._resolve_stage(job_id, parent)
-                for e in self.stage_manager.promote_pending_stage(
-                    job_id, parent
+            # check+resolve+promote under the server lock, serialized
+            # against _on_shuffle_lost: an invalidation racing this
+            # resolve would otherwise let it bake EMPTY location lists
+            # for just-lost partitions into the resolved plan bytes and
+            # promote the consumer anyway — next_task would then hand
+            # out the poisoned plan without its completeness re-check
+            # (plan_bytes present). Completion events post AFTER the
+            # lock: the event queue is bounded (racelint
+            # blocking-under-lock).
+            with self._lock:
+                if not self.stage_manager.is_pending_stage(job_id, parent):
+                    continue
+                unresolved = find_unresolved_shuffles(
+                    job.stages[parent].plan
+                )
+                if all(
+                    self.stage_manager.is_completed_stage(job_id, u.stage_id)
+                    for u in unresolved
                 ):
-                    self.event_loop.post(e)
+                    self._resolve_stage(job_id, parent)
+                    deferred.extend(
+                        self.stage_manager.promote_pending_stage(
+                            job_id, parent
+                        )
+                    )
+        for e in deferred:
+            self.event_loop.post(e)
 
     def _on_task_rescheduled(self, event: TaskRescheduled) -> None:
         """Bookkeeping for a bounded retry (visibility: REST /api/state
         exposes the count; chaos tests assert on it)."""
-        job = self.jobs.get(event.job_id)
+        job = self._get_job(event.job_id)
         if job is not None:
             job.total_retries += 1
         log.warning(
@@ -607,7 +646,7 @@ class SchedulerServer:
         output that keeps vanishing (crash-looping executor, corrupt
         writes) must eventually fail the job instead of recomputing
         forever."""
-        job = self.jobs.get(job_id)
+        job = self._get_job(job_id)
         if job is None or job.status != "running":
             return False
         with self._lock:
@@ -654,7 +693,7 @@ class SchedulerServer:
 
     def _on_job_finished(self, job_id: str) -> None:
         """Assemble CompletedJob locations (ref :370-388, :416-473)."""
-        job = self.jobs.get(job_id)
+        job = self._get_job(job_id)
         if job is None:
             return
         final = job.stages[job.final_stage_id]
@@ -675,7 +714,7 @@ class SchedulerServer:
         log.info("job %s completed (%d partitions)", job_id, len(flat))
 
     def _on_job_failed(self, job_id: str, error: str) -> None:
-        job = self.jobs.get(job_id)
+        job = self._get_job(job_id)
         if job is None:
             return
         job.status = "failed"
@@ -696,38 +735,34 @@ class SchedulerServer:
 
     # -- task handout (pull mode; ref grpc.rs:121-147) -----------------------
     def next_task(self, executor_id: str) -> pb.TaskDefinition | None:
-        pick = self.stage_manager.fetch_schedulable_stage()
-        if pick is None:
+        # atomic pick+mark inside the stage manager: two concurrent
+        # PollWork threads previously could both see the same partition
+        # PENDING (the second RUNNING mark was silently dropped as an
+        # illegal RUNNING->RUNNING hop) and both run the task
+        picked = self.stage_manager.assign_next_task(executor_id)
+        if picked is None:
             return None
-        job_id, stage_id = pick
-        # blamed-executor exclusion is a soft preference: tasks that never
-        # failed on this executor sort first, but a blamed task is still
-        # handed out when it is all that remains (a one-executor cluster
-        # must not starve itself)
-        pending = self.stage_manager.fetch_pending_tasks(
-            job_id, stage_id, 1, executor_id=executor_id
-        )
-        if not pending:
-            return None
-        partition = pending[0]
-        task_id = PartitionId(job_id, stage_id, partition)
-        events = self.stage_manager.update_task_status(
-            task_id, TaskState.RUNNING, executor_id=executor_id
-        )
+        job_id, stage_id, partition, attempt, events = picked
         for e in events:
             self.event_loop.post(e)
-        attempt = self.stage_manager.task_attempt(job_id, stage_id, partition)
-        job = self.jobs[job_id]
-        plan_bytes = job.resolved_plan_bytes.get(stage_id)
-        if plan_bytes is None:
-            # lazy (re-)resolution under the server lock, serialized against
-            # _on_shuffle_lost: recovery may have demoted this stage and
-            # dropped its resolved bytes between the schedulable pick above
-            # and here. Resolving while a producer is incomplete would bake
-            # EMPTY location lists for the lost partitions into the plan —
-            # the task would then "succeed" with rows silently missing —
-            # so re-check producer completeness first and back out.
-            with self._lock:
+        task_id = PartitionId(job_id, stage_id, partition)
+        job = self._get_job(job_id)
+        if job is None:
+            # job torn down between the pick and here; release the task
+            self.stage_manager.update_task_status(task_id, TaskState.PENDING)
+            return None
+        failure: JobFailed | None = None
+        with self._lock:
+            plan_bytes = job.resolved_plan_bytes.get(stage_id)
+            if plan_bytes is None:
+                # lazy (re-)resolution under the server lock, serialized
+                # against _on_shuffle_lost: recovery may have demoted this
+                # stage and dropped its resolved bytes between the
+                # schedulable pick above and here. Resolving while a
+                # producer is incomplete would bake EMPTY location lists
+                # for the lost partitions into the plan — the task would
+                # then "succeed" with rows silently missing — so re-check
+                # producer completeness first and back out.
                 unresolved = find_unresolved_shuffles(
                     job.stages[stage_id].plan
                 )
@@ -748,21 +783,23 @@ class SchedulerServer:
                     # roll the RUNNING mark back so the task isn't leaked
                     # on an executor that never received it, and fail the
                     # job — resolution is deterministic, retrying can't
-                    # help
+                    # help. The JobFailed is POSTED AFTER the lock is
+                    # released: the event queue is bounded, and a blocking
+                    # put under the server lock while the consumer thread
+                    # wants the same lock is the racelint deadlock shape
                     self.stage_manager.update_task_status(
                         task_id, TaskState.PENDING
                     )
-                    self.event_loop.post(
-                        JobFailed(
-                            job_id, stage_id,
-                            f"stage resolution failed: {e}",
-                        )
+                    failure = JobFailed(
+                        job_id, stage_id, f"stage resolution failed: {e}"
                     )
                     log.exception(
                         "stage %s/%s resolution failed", job_id, stage_id
                     )
-                    return None
-        cfg = self.sessions.get(job.session_id, self.config)
+        if failure is not None:
+            self.event_loop.post(failure)
+            return None
+        cfg = self._session_config(job.session_id)
         from ballista_tpu.config import BALLISTA_INTERNAL_TASK_ATTEMPT
 
         return pb.TaskDefinition(
@@ -811,16 +848,39 @@ class SchedulerServer:
 
         with self._lock:
             stub = self.executor_clients.get(executor_id)
-            if stub is not None:
-                return stub
-            em = self.executor_manager.get_executor_metadata(executor_id)
-            if em is None or not em.grpc_port:
-                return None
-            ch = _grpc.insecure_channel(f"{em.host}:{em.grpc_port}")
-            stub = executor_stub(ch)
-            self._executor_channels[executor_id] = ch
-            self.executor_clients[executor_id] = stub
+        if stub is not None:
             return stub
+        em = self.executor_manager.get_executor_metadata(executor_id)
+        if em is None or not em.grpc_port:
+            return None
+        # dial OUTSIDE the lock (racelint blocking-under-lock): channel
+        # setup toward an unreachable executor must never stall other
+        # control threads; a concurrent dial loses the store-race below
+        # and its channel is closed
+        ch = _grpc.insecure_channel(f"{em.host}:{em.grpc_port}")
+        stub = executor_stub(ch)
+        extra = None
+        with self._lock:
+            raced = self.executor_clients.get(executor_id)
+            if raced is not None:
+                stub, extra = raced, ch
+            elif (
+                self.executor_manager.get_executor_data(executor_id) is None
+            ):
+                # the expiry sweep dropped this executor while we dialed:
+                # storing now would resurrect a stale entry that a later
+                # re-registration (possibly on a new port) would keep
+                # serving dead addresses from
+                stub, extra = None, ch
+            else:
+                self._executor_channels[executor_id] = ch
+                self.executor_clients[executor_id] = stub
+        if extra is not None:
+            try:
+                extra.close()
+            except Exception:  # noqa: BLE001
+                pass
+        return stub
 
     def _offer_resources(self) -> None:
         """Round-robin pack pending tasks onto free executor slots and
@@ -830,29 +890,35 @@ class SchedulerServer:
         ReviveOffers)."""
         if self.policy != TaskSchedulingPolicy.PUSH_STAGED:
             return
+        # NO server lock around the assignment loop: ReviveOffers events
+        # are consumed solely by the single event-loop thread (the only
+        # caller), every structure touched has its own lock (executor
+        # manager slots, stage manager picks — atomic via
+        # assign_next_task), and holding the server lock across next_task
+        # would hold it across event posts — the blocking-under-lock
+        # deadlock shape racelint bans.
         assignments: dict[str, list[pb.TaskDefinition]] = {}
-        with self._lock:
-            execs = self.executor_manager.get_available_executors_data(
-                self.executor_timeout_s
-            )
-            free = sum(d.available_task_slots for d in execs)
-            i = 0
-            while free > 0:
-                d = execs[i % len(execs)]
-                i += 1
-                if d.available_task_slots <= 0:
-                    continue
-                try:
-                    td = self.next_task(d.executor_id)
-                except Exception:  # noqa: BLE001 — plan resolution failure
-                    log.exception("offer: next_task failed")
-                    break
-                if td is None:
-                    break
-                assignments.setdefault(d.executor_id, []).append(td)
-                d.available_task_slots -= 1
-                free -= 1
-                self.executor_manager.update_executor_data(d.executor_id, -1)
+        execs = self.executor_manager.get_available_executors_data(
+            self.executor_timeout_s
+        )
+        free = sum(d.available_task_slots for d in execs)
+        i = 0
+        while free > 0:
+            d = execs[i % len(execs)]
+            i += 1
+            if d.available_task_slots <= 0:
+                continue
+            try:
+                td = self.next_task(d.executor_id)
+            except Exception:  # noqa: BLE001 — plan resolution failure
+                log.exception("offer: next_task failed")
+                break
+            if td is None:
+                break
+            assignments.setdefault(d.executor_id, []).append(td)
+            d.available_task_slots -= 1
+            free -= 1
+            self.executor_manager.update_executor_data(d.executor_id, -1)
         for eid, tasks in assignments.items():
             stub = self._get_executor_client(eid)
             ok = False
@@ -962,7 +1028,7 @@ class SchedulerServer:
                 self.event_loop.post(e)
 
     def job_status_proto(self, job_id: str) -> pb.JobStatus:
-        job = self.jobs.get(job_id)
+        job = self._get_job(job_id)
         if job is None:
             return pb.JobStatus(failed=pb.FailedJob(error="unknown job"))
         if job.status == "queued":
@@ -980,16 +1046,23 @@ class SchedulerServer:
         )
 
     def shutdown(self) -> None:
+        """Stop and JOIN every thread this server started (expiry sweep,
+        event loop) — abandoning daemon threads leaks them across repeated
+        start/stop cycles in one process (tests assert a zero
+        ``threading.enumerate()`` delta)."""
         self._expiry_stop.set()
+        self._expiry_thread.join(timeout=5)
         self.event_loop.stop()
         with self._lock:
-            for ch in self._executor_channels.values():
-                try:
-                    ch.close()
-                except Exception:  # noqa: BLE001
-                    pass
+            channels = list(self._executor_channels.values())
             self._executor_channels.clear()
             self.executor_clients.clear()
+        # close outside the lock: channel teardown does socket work
+        for ch in channels:
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001
+                pass
 
 
 class SchedulerGrpcServicer:

@@ -12,8 +12,9 @@ from __future__ import annotations
 import dataclasses
 import enum
 import random
-import threading
 
+from ballista_tpu.analysis.statemachine import TASK_TRANSITIONS
+from ballista_tpu.analysis.witness import make_lock
 from ballista_tpu.errors import InternalError
 from ballista_tpu.scheduler_types import (
     PartitionId,
@@ -29,14 +30,12 @@ class TaskState(enum.Enum):
 
 
 # Legal transitions (ref stage_manager.rs:536-586: e.g. Pending->Failed is
-# ignored; Completed->Pending re-opens a stage on status reset).
+# ignored; Completed->Pending re-opens a stage on status reset). DERIVED
+# from the canonical declared table (analysis/statemachine.py) so the
+# validator and the spec racelint/property tests check against cannot
+# drift apart.
 _LEGAL = {
-    (TaskState.PENDING, TaskState.RUNNING),
-    (TaskState.RUNNING, TaskState.FAILED),
-    (TaskState.RUNNING, TaskState.COMPLETED),
-    (TaskState.RUNNING, TaskState.PENDING),  # reset (executor lost)
-    (TaskState.COMPLETED, TaskState.PENDING),  # re-open
-    (TaskState.FAILED, TaskState.PENDING),
+    (TaskState(src), TaskState(dst)) for src, dst in TASK_TRANSITIONS
 }
 
 
@@ -126,7 +125,7 @@ class StageManager:
     """In-memory running/pending/completed stage maps (ref :326-356)."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = make_lock("StageManager._lock", reentrant=True)
         self._stages: dict[tuple[str, int], Stage] = {}
         self._running: set[tuple[str, int]] = set()
         self._pending: set[tuple[str, int]] = set()
@@ -228,6 +227,38 @@ class StageManager:
             if stage is None or not (0 <= partition < stage.n_tasks):
                 return 0
             return stage.tasks[partition].attempts
+
+    def assign_next_task(
+        self, executor_id: str = ""
+    ) -> tuple[str, int, int, int, list["StageEvent"]] | None:
+        """Atomically pick a schedulable stage, choose a pending task
+        (blame-aware soft preference), and mark it RUNNING. Returns
+        ``(job_id, stage_id, partition, attempt, events)`` or None.
+
+        One critical section closes the pick/mark race: two concurrent
+        PollWork threads could both observe the same partition PENDING,
+        and the loser's PENDING->RUNNING mark was silently ignored as an
+        illegal RUNNING->RUNNING hop — both executors then ran the same
+        task (wasted slot at best, double-reported completions at
+        worst)."""
+        with self._lock:
+            pick = self.fetch_schedulable_stage()
+            if pick is None:
+                return None
+            job_id, stage_id = pick
+            pending = self.fetch_pending_tasks(
+                job_id, stage_id, 1, executor_id=executor_id
+            )
+            if not pending:
+                return None
+            partition = pending[0]
+            events = self.update_task_status(
+                PartitionId(job_id, stage_id, partition),
+                TaskState.RUNNING,
+                executor_id=executor_id,
+            )
+            attempt = self.task_attempt(job_id, stage_id, partition)
+            return job_id, stage_id, partition, attempt, events
 
     def fetch_schedulable_stage(self) -> tuple[str, int] | None:
         """A random running stage with pending tasks (ref :300-324 — random

@@ -20,8 +20,9 @@ from __future__ import annotations
 import dataclasses
 import queue
 import sqlite3
-import threading
 from typing import Iterator
+
+from ballista_tpu.analysis.witness import make_lock
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,7 +87,7 @@ class StateBackendClient:
 
     def __init__(self) -> None:
         self._watchers: list[Watch] = []
-        self._watch_lock = threading.Lock()
+        self._watch_lock = make_lock("StateBackendClient._watch_lock")
 
     def get(self, key: str) -> bytes | None:
         raise NotImplementedError
@@ -135,7 +136,7 @@ class MemoryBackend(StateBackendClient):
     def __init__(self) -> None:
         super().__init__()
         self._data: dict[str, bytes] = {}
-        self._lock = threading.RLock()
+        self._lock = make_lock("MemoryBackend._lock", reentrant=True)
 
     def get(self, key: str) -> bytes | None:
         with self._lock:
@@ -172,7 +173,7 @@ class SqliteBackend(StateBackendClient):
     def __init__(self, path: str) -> None:
         super().__init__()
         self.path = path
-        self._lock = threading.RLock()
+        self._lock = make_lock("SqliteBackend._lock", reentrant=True)
         self._conn = sqlite3.connect(path, check_same_thread=False)
         with self._lock:
             self._conn.execute("PRAGMA journal_mode=WAL")

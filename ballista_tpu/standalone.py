@@ -16,6 +16,7 @@ lost-shuffle recovery; see docs/fault_tolerance.md).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import shutil
 import tempfile
@@ -25,6 +26,8 @@ from ballista_tpu.exec.planner import TableProvider
 from ballista_tpu.executor.executor import Executor, PollLoop, new_executor_id
 from ballista_tpu.executor.flight_service import start_flight_server
 from ballista_tpu.scheduler.server import SchedulerServer, start_scheduler_grpc
+
+log = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -38,6 +41,9 @@ class ExecutorHandle:
     flight_port: int
     work_dir: str
     alive: bool = True
+    # the Flight server's serve() thread — joined on stop so repeated
+    # start/stop cycles in one process leak no threads
+    flight_thread: object = None
 
 
 @dataclasses.dataclass
@@ -127,7 +133,9 @@ class StandaloneCluster:
         # executors keep it: their build may disagree with the
         # scheduler's serde vocabulary.
         executor.verify_decoded_plans = False
-        svc, flight_port, _t = start_flight_server("127.0.0.1", 0, work_dir)
+        svc, flight_port, flight_thread = start_flight_server(
+            "127.0.0.1", 0, work_dir
+        )
         if policy == TaskSchedulingPolicy.PUSH_STAGED:
             from ballista_tpu.executor.executor_server import ExecutorServer
 
@@ -155,6 +163,7 @@ class StandaloneCluster:
             flight_service=svc,
             flight_port=flight_port,
             work_dir=work_dir,
+            flight_thread=flight_thread,
         )
         self.executors.append(handle)
         return handle
@@ -169,14 +178,30 @@ class StandaloneCluster:
         share a filesystem). Returns the dead executor's id."""
         h = self.executors[index]
         h.alive = False
+        self._stop_executor(h)
+        if lose_shuffle:
+            shutil.rmtree(h.work_dir, ignore_errors=True)
+        return h.executor.executor_id
+
+    @staticmethod
+    def _stop_executor(h: ExecutorHandle) -> None:
+        """Stop one executor's loops AND join its daemon threads: the task
+        loop (PollLoop/ExecutorServer joins its own workers) and the
+        Flight serve() thread. Abandoning them leaked one thread set per
+        start/stop cycle (tests assert a zero threading.enumerate()
+        delta across repeated cycles)."""
         h.loop.stop()
         try:
             h.flight_service.shutdown()
         except Exception:  # noqa: BLE001 — already down
             pass
-        if lose_shuffle:
-            shutil.rmtree(h.work_dir, ignore_errors=True)
-        return h.executor.executor_id
+        t = h.flight_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+            if t.is_alive():
+                log.warning(
+                    "flight serve() thread outlived the join timeout"
+                )
 
     def attach_provider(self, provider: TableProvider) -> None:
         """Point scheduler planning + executor decode at a shared table
@@ -190,11 +215,10 @@ class StandaloneCluster:
     def stop(self) -> None:
         for h in self.executors:
             if h.alive:
-                h.loop.stop()
-                try:
-                    h.flight_service.shutdown()
-                except Exception:  # noqa: BLE001
-                    pass
+                self._stop_executor(h)
         self.scheduler.shutdown()
-        self.scheduler_grpc.stop(grace=None)
+        # wait for the gRPC worker pool to wind down, not just signal it
+        ev = self.scheduler_grpc.stop(grace=None)
+        if ev is not None:
+            ev.wait(timeout=5)
         self._tmp.cleanup()

@@ -234,9 +234,16 @@ def _load_env() -> None:
             log.exception("invalid %s spec ignored", ENV_FAULTS)
 
 
-def active() -> FaultInjector | None:
+def active() -> FaultInjector | None:  # racelint: disable=unguarded-field
     """The installed injector, or None. First call parses the env; after
-    that the disabled path is a single global read."""
+    that the disabled path is a single global read.
+
+    Deliberate double-checked read of ``_ENV_LOADED``/``_INJECTOR``
+    outside ``_ENV_LOCK`` (the racelint suppression above): this sits on
+    every task/fetch/heartbeat hot path, so the disabled case must stay a
+    lone global load. ``_load_env`` re-checks under the lock, and both
+    globals only ever transition once (False->True, None->injector), so a
+    stale read is benign — GIL-visible by the next call."""
     if not _ENV_LOADED:
         _load_env()
     return _INJECTOR

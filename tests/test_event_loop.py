@@ -50,6 +50,37 @@ def test_stop_does_not_deadlock_on_full_queue(monkeypatch):
     assert time.time() - t0 < 10
 
 
+def test_consumer_thread_posts_survive_full_queue():
+    """Events posted from INSIDE a handler (the consumer thread) must
+    never be dropped when the bounded queue is full — a dropped terminal
+    event (JobFailed) would wedge its job forever. They spill into the
+    unbounded overflow deque and are all processed."""
+
+    class _Fanout(EventAction):
+        def __init__(self):
+            self.seen = []
+            self.loop = None
+
+        def on_receive(self, event):
+            self.seen.append(event)
+            if event == "boom":
+                # post far more than the queue holds, from the consumer
+                for i in range(20):
+                    self.loop.post(("child", i))
+            return None
+
+    action = _Fanout()
+    loop = EventLoop("t3", action)
+    loop._q.maxsize = 4  # tiny buffer: the fan-out MUST overflow
+    action.loop = loop
+    loop.start()
+    loop.post("boom")
+    loop.drain(timeout=10)
+    children = [e for e in action.seen if isinstance(e, tuple)]
+    assert len(children) == 20, f"lost {20 - len(children)} handler posts"
+    loop.stop()
+
+
 def test_run_loop_honors_stop_without_sentinel():
     class _Count(EventAction):
         def __init__(self):

@@ -1,0 +1,131 @@
+"""Flight connection-pool discipline (ISSUE 4 satellite).
+
+The ``(host, port)`` pool from PR 3 is shared by every concurrent shuffle
+reader, so its lock discipline matters:
+
+- eviction must NOT close the evicted client — other threads may be
+  mid-``do_get`` on the shared channel, and closing under them turns
+  healthy streams into spurious failures (the client dies by GC once the
+  last user drops it);
+- dialing happens OUTSIDE the pool lock (racelint blocking-under-lock —
+  a slow handshake to one dead peer must not serialize fetches to healthy
+  peers), with the dial-race loser's channel closed, since nobody else
+  can have seen it;
+- ``close_pool`` closes outside the lock, after emptying the pool.
+
+Tested with stand-in client objects (no sockets needed — the contract
+under test is pool bookkeeping, not Arrow Flight)."""
+
+import threading
+
+import ballista_tpu.client.flight as flight
+
+
+class _FakeClient:
+    def __init__(self, name="c"):
+        self.name = name
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+
+def _clean_pool():
+    with flight._POOL_LOCK:
+        flight._POOL.clear()
+
+
+def test_evict_removes_without_closing_inflight_client():
+    _clean_pool()
+    c = _FakeClient()
+    with flight._POOL_LOCK:
+        flight._POOL[("h", 1)] = c
+    flight._evict("h", 1, c)
+    assert ("h", 1) not in flight._POOL
+    assert not c.closed, (
+        "eviction closed a client other threads may be mid-fetch on"
+    )
+
+
+def test_evict_ignores_stale_client():
+    """A thread holding a pre-eviction reference must not evict the
+    REPLACEMENT connection when it reports its own (stale) failure."""
+    _clean_pool()
+    stale, fresh = _FakeClient("stale"), _FakeClient("fresh")
+    with flight._POOL_LOCK:
+        flight._POOL[("h", 1)] = fresh
+    flight._evict("h", 1, stale)
+    assert flight._POOL[("h", 1)] is fresh
+    assert not fresh.closed and not stale.closed
+
+
+def test_client_for_dials_outside_lock_and_closes_race_loser(monkeypatch):
+    _clean_pool()
+    dialed = []
+
+    def fake_connect(uri):
+        c = _FakeClient(uri)
+        dialed.append(c)
+        if len(dialed) == 1:
+            # simulate a concurrent dial winning the store-race while WE
+            # were connecting (possible exactly because the dial is
+            # outside the pool lock)
+            with flight._POOL_LOCK:
+                flight._POOL[("h", 1)] = _FakeClient("winner")
+        return c
+
+    monkeypatch.setattr(flight.paflight, "connect", fake_connect)
+    got = flight._client_for("h", 1)
+    assert got.name == "winner", "race winner must be returned"
+    assert dialed[0].closed, "race loser's channel must be closed"
+    # cached path: no new dial
+    again = flight._client_for("h", 1)
+    assert again is got and len(dialed) == 1
+    _clean_pool()
+
+
+def test_close_pool_closes_every_cached_client():
+    _clean_pool()
+    cs = [_FakeClient(str(i)) for i in range(3)]
+    with flight._POOL_LOCK:
+        for i, c in enumerate(cs):
+            flight._POOL[("h", i)] = c
+    flight.close_pool()
+    assert all(c.closed for c in cs)
+    assert not flight._POOL
+
+
+def test_concurrent_client_for_returns_single_cached_client(monkeypatch):
+    _clean_pool()
+    dial_count = []
+    gate = threading.Event()
+
+    def slow_connect(uri):
+        gate.wait(timeout=5)  # every dialer stalls here, outside the lock
+        c = _FakeClient(uri)
+        dial_count.append(c)
+        return c
+
+    monkeypatch.setattr(flight.paflight, "connect", slow_connect)
+    got = []
+    lock = threading.Lock()
+
+    def worker():
+        c = flight._client_for("h", 9)
+        with lock:
+            got.append(c)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    gate.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(got) == 4 and len(set(id(c) for c in got)) == 1, (
+        "all concurrent fetchers must share one pooled client"
+    )
+    # losers' channels were closed, the shared one stays open
+    shared = got[0]
+    assert not shared.closed
+    assert all(c.closed for c in dial_count if c is not shared)
+    _clean_pool()

@@ -1,0 +1,471 @@
+"""racelint: lock-discipline + state-machine static analysis (ISSUE 4).
+
+Tier-1 contract: the analyzer runs CLEAN over the concurrent control
+plane (scheduler/, executor/, client/flight.py, event_loop.py,
+standalone.py, testing/faults.py) within the suppression budget, every
+rule family both accepts a clean exemplar and rejects a seeded mutation,
+the lock-order graph is acyclic and exported, the canonical state-machine
+tables govern the runtime validator, and the combined
+``python -m ballista_tpu.analysis`` gate aggregates all four analyzers
+into one exit code."""
+
+import textwrap
+import threading
+
+import pytest
+
+from ballista_tpu.analysis import racelint, witness
+from ballista_tpu.analysis.statemachine import (
+    JOB_TRANSITIONS,
+    STAGE_TRANSITIONS,
+    TASK_TRANSITIONS,
+    render_tables,
+)
+
+_HEADER = "import threading\nimport time\n"
+
+
+def _lint(body: str):
+    return racelint.lint_source(_HEADER + textwrap.dedent(body), "synth.py")
+
+
+# ------------------------------------------------------------ tier-1 gate --
+
+
+def test_control_plane_lints_clean():
+    """The shipped control plane has zero racelint findings (tier-1)."""
+    diags = racelint.lint_paths()
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
+def test_suppressions_stay_rare():
+    """Escape-hatch budget: ≤ 5 tree-wide (currently 1 — the documented
+    double-checked fast path in testing/faults.py active())."""
+    assert racelint.suppression_count() <= 5
+
+
+def test_rule_catalog_documented():
+    assert set(racelint.RULES) == {
+        "unguarded-field", "lock-order-cycle", "blocking-under-lock",
+        "undeclared-transition",
+    }
+    assert all(len(v) > 20 for v in racelint.RULES.values())
+
+
+def test_lock_order_graph_exported_and_acyclic():
+    edges = racelint.lock_order_graph()
+    # the known inter-class orders of the control plane
+    assert ("SchedulerServer._lock", "StageManager._lock") in edges
+    assert ("SchedulerServer._lock", "ExecutorManager._lock") in edges
+    # no reverse edges (acyclicity is also what rule 2 enforces)
+    for (a, b) in edges:
+        assert (b, a) not in edges, (a, b)
+    dot = racelint.lock_order_dot()
+    assert dot.startswith("digraph") and "SchedulerServer._lock" in dot
+
+
+# ------------------------------------------- rule 1: unguarded-field -------
+
+
+def test_unguarded_field_rejects_and_accepts():
+    bad = _lint(
+        """
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0
+            def set(self, v):
+                with self._lock:
+                    self.x = v
+            def peek(self):
+                return self.x
+        """
+    )
+    assert [d.rule for d in bad] == ["unguarded-field"]
+    assert "C.x" in bad[0].message and bad[0].function == "peek"
+    ok = _lint(
+        """
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0
+            def set(self, v):
+                with self._lock:
+                    self.x = v
+            def peek(self):
+                with self._lock:
+                    return self.x
+        """
+    )
+    assert ok == []
+
+
+def test_unguarded_module_global():
+    bad = _lint(
+        """
+        _LOCK = threading.Lock()
+        _STATE = {}
+        def put(k, v):
+            with _LOCK:
+                _STATE[k] = v
+        def peek(k):
+            return _STATE.get(k)
+        """
+    )
+    assert [d.rule for d in bad] == ["unguarded-field"]
+    assert "_STATE" in bad[0].message
+
+
+def test_init_is_exempt():
+    ok = _lint(
+        """
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0  # construction is single-threaded
+            def bump(self):
+                with self._lock:
+                    self.x += 1
+        """
+    )
+    assert ok == []
+
+
+# ---------------------------------------- rule 2: lock-order cycles --------
+
+
+_CYCLE = """
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.b = B()
+    def m1(self):
+        with self._lock:
+            self.b.m2()
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.a = A()
+    def m2(self):
+        with self._lock:
+            pass
+    def m3(self):
+        with self._lock:
+            self.a.m1()
+"""
+
+
+def test_lock_order_cycle_rejected_and_acyclic_accepted():
+    bad = _lint(_CYCLE)
+    assert any(d.rule == "lock-order-cycle" for d in bad), bad
+    ok = _lint(_CYCLE.replace(
+        "    def m3(self):\n        with self._lock:\n            self.a.m1()\n",
+        "",
+    ))
+    assert [d for d in ok if d.rule == "lock-order-cycle"] == []
+
+
+def test_non_reentrant_reacquire_flagged():
+    bad = _lint(
+        """
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def outer(self):
+                with self._lock:
+                    self.inner()
+            def inner(self):
+                with self._lock:
+                    pass
+        """
+    )
+    assert any(
+        d.rule == "lock-order-cycle" and "non-reentrant" in d.message
+        for d in bad
+    ), bad
+    # the same shape on an RLock is legal re-entrancy
+    ok = _lint(
+        """
+        class C:
+            def __init__(self):
+                self._lock = threading.RLock()
+            def outer(self):
+                with self._lock:
+                    self.inner()
+            def inner(self):
+                with self._lock:
+                    pass
+        """
+    )
+    assert [d for d in ok if d.rule == "lock-order-cycle"] == []
+
+
+# -------------------------------------- rule 3: blocking under lock --------
+
+
+def test_blocking_under_lock_direct_and_transitive():
+    bad = _lint(
+        """
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def direct(self):
+                with self._lock:
+                    time.sleep(0.1)
+            def helper(self):
+                time.sleep(0.1)
+            def transitive(self):
+                with self._lock:
+                    self.helper()
+        """
+    )
+    rules = [d.rule for d in bad]
+    assert rules.count("blocking-under-lock") == 2, bad
+    ok = _lint(
+        """
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def fine(self):
+                with self._lock:
+                    x = 1
+                time.sleep(0.1)
+        """
+    )
+    assert ok == []
+
+
+def test_blocking_queue_put_under_lock_flagged():
+    """The PR 3 deadlock shape: a bounded-queue put while holding a lock
+    the consumer thread needs."""
+    bad = _lint(
+        """
+        import queue
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue(maxsize=10)
+            def post(self, event):
+                with self._lock:
+                    self._q.put(event)
+        """
+    )
+    assert [d.rule for d in bad] == ["blocking-under-lock"]
+    # KV-store put(key, value) is NOT a queue put
+    ok = _lint(
+        """
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.backend = None
+            def save(self, k, v):
+                with self._lock:
+                    self.backend.put(k, v)
+        """
+    )
+    assert ok == []
+
+
+# ------------------------------------ rule 4: undeclared transitions -------
+
+
+def test_undeclared_task_transition_rejected():
+    bad = _lint(
+        """
+        class TaskState:
+            pass
+        def f(t):
+            if t.state == TaskState.PENDING:
+                t.state = TaskState.COMPLETED
+        """
+    )
+    assert [d.rule for d in bad] == ["undeclared-transition"]
+    assert "pending -> completed" in bad[0].message
+
+
+def test_declared_task_transition_accepted():
+    ok = _lint(
+        """
+        class TaskState:
+            pass
+        def f(t):
+            if t.state == TaskState.RUNNING:
+                t.state = TaskState.PENDING
+        """
+    )
+    assert ok == []
+
+
+def test_dynamic_assignment_requires_table_guard():
+    bad = _lint(
+        """
+        class TaskState:
+            pass
+        def f(t, new_state):
+            t.state = new_state
+        """
+    )
+    assert [d.rule for d in bad] == ["undeclared-transition"]
+    ok = _lint(
+        """
+        class TaskState:
+            pass
+        _LEGAL = set()
+        def f(t, new_state):
+            if (t.state, new_state) not in _LEGAL:
+                return
+            t.state = new_state
+        """
+    )
+    assert ok == []
+
+
+def test_undeclared_job_state_rejected():
+    bad = _lint(
+        """
+        def f(job):
+            job.status = "zombie"
+        """
+    )
+    assert [d.rule for d in bad] == ["undeclared-transition"]
+    ok = _lint(
+        """
+        def f(job):
+            job.status = "failed"
+        """
+    )
+    assert ok == []
+
+
+# ------------------------------------------------------- suppression -------
+
+
+def test_suppression_line_and_function_scope():
+    ok = _lint(
+        """
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0
+            def set(self, v):
+                with self._lock:
+                    self.x = v
+            def peek(self):
+                return self.x  # racelint: disable=unguarded-field
+        """
+    )
+    assert ok == []
+    ok2 = _lint(
+        """
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0
+            def set(self, v):
+                with self._lock:
+                    self.x = v
+            def peek(self):  # racelint: disable=all
+                return self.x
+        """
+    )
+    assert ok2 == []
+
+
+# --------------------------------------------------- state machine ---------
+
+
+def test_tables_govern_runtime_validator():
+    """stage_manager._LEGAL is DERIVED from the declared table — code and
+    spec cannot drift."""
+    from ballista_tpu.scheduler.stage_manager import _LEGAL
+
+    assert {(a.value, b.value) for a, b in _LEGAL} == set(TASK_TRANSITIONS)
+
+
+def test_tables_render_and_cover_states():
+    text = render_tables()
+    assert "task transitions" in text and "job transitions" in text
+    assert ("completed", "pending") in TASK_TRANSITIONS  # lost-shuffle
+    assert ("completed", "running") in STAGE_TRANSITIONS  # rollback
+    assert ("running", "failed") in JOB_TRANSITIONS
+
+
+# ------------------------------------------------------ runtime witness ----
+
+
+def test_witness_records_orders_and_flags_inversion():
+    witness.reset()
+    witness.enable(True)
+    try:
+        a = witness.make_lock("T.A")
+        b = witness.make_lock("T.B")
+        with a:
+            with b:
+                pass
+        assert ("T.A", "T.B") in witness.edges()
+        assert witness.violations() == []
+        witness.assert_consistent([("T.A", "T.B")])
+        # the static graph ordering B before A would be an inversion
+        with pytest.raises(AssertionError):
+            witness.assert_consistent([("T.B", "T.A")])
+
+        # live inversion from another thread
+        def invert():
+            with b:
+                with a:
+                    pass
+
+        t = threading.Thread(target=invert)
+        t.start()
+        t.join()
+        assert witness.violations(), "B->A after A->B must be flagged"
+    finally:
+        witness.enable(False)
+        witness.reset()
+
+
+def test_witness_reentrant_lock_records_no_self_edge():
+    witness.reset()
+    witness.enable(True)
+    try:
+        a = witness.make_lock("T.R", reentrant=True)
+        with a:
+            with a:
+                pass
+        assert ("T.R", "T.R") not in witness.edges()
+        assert witness.violations() == []
+    finally:
+        witness.enable(False)
+        witness.reset()
+
+
+def test_witness_disabled_returns_plain_locks():
+    assert not witness.enabled()
+    lk = witness.make_lock("T.plain")
+    assert not isinstance(lk, witness.TracedLock)
+
+
+# ------------------------------------------------------ combined gate ------
+
+
+def test_combined_analysis_gate_is_clean():
+    """`python -m ballista_tpu.analysis` aggregates planlint + serde-audit
+    + jaxlint + racelint into one exit code, with a summary line per
+    analyzer. planlint runs a TPC-H subset here — the full corpus is
+    tier-1 via test_plan_verifier.py."""
+    from ballista_tpu.analysis.__main__ import run_all
+
+    lines: list[str] = []
+    rc = run_all(queries=[1, 3, 6], out=lines.append)
+    assert rc == 0, "\n".join(lines)
+    for name in ("planlint", "serde-audit", "jaxlint", "racelint"):
+        assert any(ln.startswith(f"{name}: OK") for ln in lines), lines
+
+
+def test_cli_dot_and_tables_flags(capsys):
+    from ballista_tpu.analysis.__main__ import main
+
+    assert main(["--dot"]) == 0
+    assert "digraph lock_order" in capsys.readouterr().out
+    assert main(["--tables"]) == 0
+    assert "task transitions" in capsys.readouterr().out

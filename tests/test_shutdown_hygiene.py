@@ -1,0 +1,92 @@
+"""Graceful-shutdown audit (ISSUE 4 satellite): StandaloneCluster /
+SchedulerServer / PollLoop / ExecutorServer must JOIN their daemon threads
+(expiry sweep, event loop, heartbeater, runners, Flight serve) on stop
+instead of abandoning them — repeated start/stop cycles in one process
+must leak zero threads.
+
+Runs in ONE subprocess (cleaned JAX-on-CPU env) covering BOTH scheduling
+policies. A warm-up cycle runs first so process-global singletons (gRPC
+pollers, Arrow/Flight internals) are excluded from the baseline; after
+that, two full start/stop cycles per policy must return
+``threading.enumerate()`` to exactly the baseline set.
+"""
+
+import subprocess
+import sys
+
+from tests.conftest import CPU_MESH_ENV
+
+SCRIPT = r"""
+import threading
+import time
+
+from ballista_tpu.config import TaskSchedulingPolicy
+from ballista_tpu.standalone import StandaloneCluster
+
+PULL = TaskSchedulingPolicy.PULL_STAGED
+PUSH = TaskSchedulingPolicy.PUSH_STAGED
+
+
+def cycle(policy):
+    cluster = StandaloneCluster.start(
+        n_executors=2,
+        concurrent_tasks=2,
+        policy=policy,
+        expiry_check_interval_s=0.2,
+    )
+    # let every loop (poll/heartbeat/expiry/event) take at least one tick
+    time.sleep(0.6)
+    cluster.stop()
+
+
+def live_threads():
+    return {t for t in threading.enumerate() if t.is_alive()}
+
+
+def settle(baseline=None, timeout=15.0):
+    # poll until the live-thread set stops changing (baseline=None) or
+    # matches the baseline; returns the leftover delta
+    deadline = time.time() + timeout
+    prev = live_threads()
+    while time.time() < deadline:
+        time.sleep(0.2)
+        cur = live_threads()
+        if baseline is None:
+            if cur == prev:
+                return cur
+            prev = cur
+        else:
+            leaked = cur - baseline
+            if not leaked:
+                return set()
+    return (live_threads() - baseline) if baseline is not None else prev
+
+
+# warm-up: first-use process-global machinery (gRPC pollers, Arrow
+# internals) spawns threads that never die by design — excluded from the
+# baseline by running one full cycle of each policy before snapshotting
+cycle(PULL)
+cycle(PUSH)
+baseline = settle()
+
+for policy in (PULL, PUSH, PULL, PUSH):  # two cycles per policy
+    cycle(policy)
+
+leaked = settle(baseline)
+assert not leaked, f"leaked threads after cycles: {[t.name for t in leaked]}"
+print("SHUTDOWN-HYGIENE-OK")
+"""
+
+
+def test_no_thread_leak_across_cluster_cycles():
+    proc = subprocess.run(
+        [sys.executable, "-c", SCRIPT],
+        env=CPU_MESH_ENV,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "SHUTDOWN-HYGIENE-OK" in proc.stdout
