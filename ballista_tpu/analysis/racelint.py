@@ -1108,6 +1108,11 @@ _DEFAULT_TARGETS = (
     "event_loop.py",
     "standalone.py",
     "testing/faults.py",
+    # streaming-pipeline primitives: bounded-queue handoff between
+    # background workers and consuming generators (the shuffle reader's
+    # overlapped fetch lives in executor/, covered above; the scan
+    # prefetch pipeline lives here)
+    "exec/pipeline.py",
 )
 
 

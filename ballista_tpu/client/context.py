@@ -164,9 +164,20 @@ class BallistaContext(TpuContext):
     def _fetch_results(
         self, completed: pb.CompletedJob, logical: LogicalPlan
     ) -> pa.Table:
+        # fetch_partition_table per location: local partitions come back
+        # zero-copy off a memory map and remote ones are assembled from
+        # the streamed Flight batch path — nothing buffers a partition ON
+        # TOP of the result — while each location's fetch stays atomic
+        # and therefore fully retryable on transient transport errors.
+        # (Streaming fetch_partition_batches here would be WRONG: its
+        # retry stops after the first yielded batch — correct under the
+        # scheduler's task-level retry, but no such layer exists above
+        # this client-side result fetch.) Arrow tables share buffers, so
+        # flattening to batches for the single from_batches below copies
+        # nothing.
         from ballista_tpu.executor.reader import fetch_partition_table
 
-        tables = []
+        batches = []
         for loc_p in completed.partition_location:
             loc = PartitionLocation(
                 job_id=loc_p.partition_id.job_id,
@@ -179,8 +190,8 @@ class BallistaContext(TpuContext):
             )
             t = fetch_partition_table(loc)
             if t.num_rows:
-                tables.append(t)
-        if not tables:
+                batches.extend(t.to_batches())
+        if not batches:
             from ballista_tpu.columnar.arrow_interop import schema_to_arrow
             from ballista_tpu.plan.optimizer import optimize
 
@@ -188,7 +199,7 @@ class BallistaContext(TpuContext):
             return pa.table(
                 {f.name: pa.array([], type=f.type) for f in schema}
             )
-        return pa.concat_tables(tables)
+        return pa.Table.from_batches(batches)
 
 
 class RemoteDataFrame(DataFrame):

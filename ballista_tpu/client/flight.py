@@ -129,14 +129,30 @@ def backoff_s(loc: PartitionLocation, attempt: int, backoff_ms: int) -> float:
     return base * jitter
 
 
-def make_ticket(loc: PartitionLocation) -> paflight.Ticket:
+def make_ticket(
+    loc: PartitionLocation, compression: str = ""
+) -> paflight.Ticket:
+    """``compression`` (none|lz4|zstd) rides the Action's settings so the
+    SERVING executor compresses the Flight stream's IPC buffers — the
+    session's ballista.tpu.shuffle_compression applied to bytes on the
+    wire, not just bytes on disk. Empty = server streams uncompressed."""
+    from ballista_tpu.config import BALLISTA_SHUFFLE_COMPRESSION
+
+    settings = []
+    if compression and compression != "none":
+        settings.append(
+            pb.KeyValuePair(
+                key=BALLISTA_SHUFFLE_COMPRESSION, value=compression
+            )
+        )
     action = pb.Action(
         fetch_partition=pb.FetchPartition(
             job_id=loc.job_id,
             stage_id=loc.stage_id,
             partition_id=loc.partition,
             path=loc.path,
-        )
+        ),
+        settings=settings,
     )
     return paflight.Ticket(action.SerializeToString())
 
@@ -185,8 +201,11 @@ def fetch_partition(
 ) -> pa.Table:
     """ref client.rs fetch_partition (:75-130). Materializes the whole
     partition — use for RESULT fetches; shuffle readers should stream via
-    fetch_partition_batches. ``read_all`` is atomic (nothing is consumed
-    on failure), so every transient attempt is safely retryable."""
+    fetch_partition_batches. The table is assembled from the streamed
+    batches (``read_all`` double-buffered the partition inside the Flight
+    reader before handing it over); every transient attempt stays safely
+    retryable because the partial batch list is private to this call and
+    discarded on retry — nothing flowed downstream."""
     retries = DEFAULT_FETCH_RETRIES if retries is None else max(1, retries)
     backoff_ms = (
         DEFAULT_FETCH_BACKOFF_MS if backoff_ms is None else backoff_ms
@@ -194,12 +213,22 @@ def fetch_partition(
     timeout_s = DEFAULT_FETCH_TIMEOUT_S if timeout_s is None else timeout_s
     for attempt in range(retries):
         client = None
+        reader = None
         try:
             _inject_fetch_fault(loc, attempt)
             client = _client_for(loc.host, loc.port)
-            return client.do_get(
+            reader = client.do_get(
                 make_ticket(loc), options=_call_options(timeout_s)
-            ).read_all()
+            )
+            try:
+                schema = reader.schema
+                batches = [
+                    chunk.data for chunk in reader if chunk.data is not None
+                ]
+            finally:
+                with contextlib.suppress(Exception):
+                    reader.cancel()
+            return pa.Table.from_batches(batches, schema=schema)
         except _TRANSIENT_FLIGHT_ERRORS as e:
             if client is not None:
                 _evict(loc.host, loc.port, client)
@@ -216,6 +245,7 @@ def fetch_partition_batches(
     retries: int | None = None,
     backoff_ms: int | None = None,
     timeout_s: float | None = None,
+    compression: str = "",
 ):
     """Stream a remote shuffle partition batch-at-a-time (the server side
     is a GeneratorStream over the IPC file) — peak memory is one record
@@ -239,7 +269,8 @@ def fetch_partition_batches(
             _inject_fetch_fault(loc, attempt)
             client = _client_for(loc.host, loc.port)
             reader = client.do_get(
-                make_ticket(loc), options=_call_options(timeout_s)
+                make_ticket(loc, compression),
+                options=_call_options(timeout_s),
             )
             try:
                 for chunk in reader:

@@ -135,8 +135,22 @@ class DeviceBatch:
 
     @classmethod
     def empty(cls, schema: Schema, capacity: int = MIN_CAPACITY) -> "DeviceBatch":
+        # STRING fields carry an (empty) dictionary: string operators key
+        # off the dictionary's presence, and a zero-row batch — e.g. an
+        # empty shuffle partition flowing into a string filter — must look
+        # like any other string column, not like a missing one
+        from ballista_tpu.datatypes import DataType
+
         return cls.from_host(
-            schema, [np.zeros(0, f.dtype.to_np()) for f in schema], 0, capacity=capacity
+            schema,
+            [np.zeros(0, f.dtype.to_np()) for f in schema],
+            0,
+            dictionaries={
+                f.name: Dictionary(())
+                for f in schema
+                if f.dtype == DataType.STRING
+            },
+            capacity=capacity,
         )
 
     # -- accessors -----------------------------------------------------------

@@ -50,6 +50,29 @@ BALLISTA_TASK_MAX_ATTEMPTS = "ballista.tpu.task_max_attempts"  # bounded task re
 BALLISTA_FETCH_RETRIES = "ballista.tpu.fetch_retries"  # Flight fetch attempts
 BALLISTA_FETCH_BACKOFF_MS = "ballista.tpu.fetch_backoff_ms"  # base fetch backoff
 BALLISTA_FETCH_TIMEOUT_S = "ballista.tpu.fetch_timeout_s"  # per-attempt deadline
+BALLISTA_SHUFFLE_FETCH_CONCURRENCY = (
+    "ballista.tpu.shuffle_fetch_concurrency"  # overlapped shuffle fetch
+)
+BALLISTA_SHUFFLE_COMPRESSION = (
+    "ballista.tpu.shuffle_compression"  # IPC codec: none|lz4|zstd
+)
+BALLISTA_SHUFFLE_LOCAL_FASTPATH = (
+    "ballista.tpu.shuffle_local_fastpath"  # direct file reads when colocated
+)
+BALLISTA_EAGER_SHUFFLE = "ballista.tpu.eager_shuffle"  # pre-barrier consumption
+BALLISTA_EAGER_POLL_MS = "ballista.tpu.eager_poll_ms"  # location poll cadence
+BALLISTA_EAGER_WAIT_S = "ballista.tpu.eager_wait_s"  # unpublished-location deadline
+
+SHUFFLE_COMPRESSION_CODECS = ("none", "lz4", "zstd")
+
+
+def _parse_shuffle_compression(s: str) -> str:
+    v = s.lower()
+    if v not in SHUFFLE_COMPRESSION_CODECS:
+        raise ValueError(
+            f"not a shuffle codec (none|lz4|zstd): {s!r}"
+        )
+    return v
 
 # Task-scoped keys the scheduler stamps onto TaskDefinition props for the
 # executor (attempt number for fault keying / logging). NOT session config:
@@ -282,6 +305,77 @@ def _entries() -> dict[str, ConfigEntry]:
             "300",
             float,
         ),
+        ConfigEntry(
+            BALLISTA_SHUFFLE_FETCH_CONCURRENCY,
+            "Upstream shuffle locations a ShuffleReaderExec pulls "
+            "CONCURRENTLY (each into a small bounded batch queue) while "
+            "the device consumes earlier ones in order — network/disk "
+            "overlapped with compute, yield order (and therefore results) "
+            "identical to the sequential pull. <= 1 restores the "
+            "sequential fetch loop (the A/B baseline).",
+            "4",
+            int,
+        ),
+        ConfigEntry(
+            BALLISTA_SHUFFLE_COMPRESSION,
+            "IPC buffer compression for shuffle files and Flight shuffle "
+            "streams: none|lz4|zstd. Applied by ShuffleWriterExec via "
+            "pa.ipc.IpcWriteOptions and requested from the serving "
+            "executor per Flight ticket; readers auto-detect per file, so "
+            "mixed codecs within one consumed partition (rolling "
+            "upgrades) are fine. lz4 is cheap enough to win whenever "
+            "shuffle bytes cross a NIC; none removes the codec work for "
+            "purely local runs.",
+            "lz4",
+            _parse_shuffle_compression,
+        ),
+        ConfigEntry(
+            BALLISTA_SHUFFLE_LOCAL_FASTPATH,
+            "Read a shuffle partition straight off the filesystem "
+            "(zero-copy mmap) whenever its path exists locally — the "
+            "colocated/standalone-cluster fast path. Off forces every "
+            "fetch through the serving executor's Flight endpoint: the "
+            "separate-hosts data path, and the right setting when a "
+            "shared volume (NFS) makes 'local' paths secretly remote. "
+            "bench.py's shuffle A/B turns it off to measure the wire "
+            "pipeline on one box.",
+            "true",
+            _parse_bool,
+        ),
+        ConfigEntry(
+            BALLISTA_EAGER_SHUFFLE,
+            "Publish completed map-task shuffle locations to scheduled "
+            "consumer tasks BEFORE the producing stage fully completes "
+            "(docs/shuffle.md): consumers of a pending stage whose "
+            "producers are all in flight with some output already "
+            "committed start fetching early, overlapping upstream "
+            "compute with downstream fetch. Stage promotion remains the "
+            "commit point, so lineage recovery and the stage verifier "
+            "are unchanged. Off restores strictly barriered consumption.",
+            "true",
+            _parse_bool,
+        ),
+        ConfigEntry(
+            BALLISTA_EAGER_POLL_MS,
+            "Cadence (ms) at which an eager shuffle reader re-polls the "
+            "scheduler for newly published upstream locations. The poll "
+            "is one small unary RPC; a short cadence matters because a "
+            "blocked reader's completion latency quantizes to it (one "
+            "stage boundary per query stage) while the scheduler-side "
+            "cost stays trivial.",
+            "10",
+            int,
+        ),
+        ConfigEntry(
+            BALLISTA_EAGER_WAIT_S,
+            "Deadline (seconds) an eager reader waits for a "
+            "not-yet-published upstream location before failing the task "
+            "back to the scheduler (bounded retry) — distinguishes "
+            "'not yet published' (wait) from a wedged producer. 0 "
+            "disables the deadline.",
+            "60",
+            float,
+        ),
     ]
     return {e.name: e for e in ents}
 
@@ -408,6 +502,24 @@ class BallistaConfig:
 
     def fetch_timeout_s(self) -> float:
         return max(0.0, self._get(BALLISTA_FETCH_TIMEOUT_S))
+
+    def shuffle_fetch_concurrency(self) -> int:
+        return max(0, self._get(BALLISTA_SHUFFLE_FETCH_CONCURRENCY))
+
+    def shuffle_compression(self) -> str:
+        return self._get(BALLISTA_SHUFFLE_COMPRESSION)
+
+    def shuffle_local_fastpath(self) -> bool:
+        return self._get(BALLISTA_SHUFFLE_LOCAL_FASTPATH)
+
+    def eager_shuffle(self) -> bool:
+        return self._get(BALLISTA_EAGER_SHUFFLE)
+
+    def eager_poll_ms(self) -> int:
+        return max(1, self._get(BALLISTA_EAGER_POLL_MS))
+
+    def eager_wait_s(self) -> float:
+        return max(0.0, self._get(BALLISTA_EAGER_WAIT_S))
 
     def __eq__(self, other) -> bool:
         return (

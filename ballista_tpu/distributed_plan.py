@@ -268,3 +268,29 @@ def remove_unresolved_shuffles(
     if all(a is b for a, b in zip(plan.children(), children)):
         return plan  # no placeholder below: share the subtree
     return _with_children(copy.copy(plan), children)
+
+
+def resolve_shuffles_eager(plan: ExecutionPlan, job_id: str) -> ExecutionPlan:
+    """Eager-mode resolution (ballista.tpu.eager_shuffle, docs/shuffle.md):
+    replace every placeholder with an EAGER ShuffleReaderExec that carries
+    only the producing (job, stage) and polls the scheduler for published
+    locations at execute time — usable BEFORE the producer stage fully
+    completes, unlike :func:`remove_unresolved_shuffles` which needs the
+    committed location set. Same copy-on-write discipline: ``plan`` stays
+    the pristine template."""
+    import copy
+
+    from ballista_tpu.executor.reader import ShuffleReaderExec
+
+    if isinstance(plan, UnresolvedShuffleExec):
+        return ShuffleReaderExec(
+            [[] for _ in range(plan.output_partition_count)],
+            plan.schema(),
+            job_id=job_id,
+            stage_id=plan.stage_id,
+            eager=True,
+        )
+    children = [resolve_shuffles_eager(c, job_id) for c in plan.children()]
+    if all(a is b for a, b in zip(plan.children(), children)):
+        return plan
+    return _with_children(copy.copy(plan), children)

@@ -76,6 +76,11 @@ class TaskContext:
     # attempt — run_with_capacity_retry closes it (deleting the files) at
     # every attempt boundary, so retries never see stale buckets.
     spill: object | None = None
+    # Eager-shuffle location poller (docs/shuffle.md), injected by a
+    # scheduler-connected executor: callable (job_id, stage_id, partition)
+    # -> executor.reader.ShuffleLocationsView | None. None in local
+    # contexts — eager ShuffleReaderExec plans refuse to run without it.
+    shuffle_locations: object | None = None
 
     def spill_manager(self):
         """The attempt's SpillManager, created on first spill. Files land

@@ -45,11 +45,23 @@ class Executor:
         work_dir: str,
         provider: TableProvider | None = None,
         metrics_collector=None,
+        scheduler_addr: str = "",
     ):
         self.executor_id = executor_id
         self.work_dir = work_dir
         self.provider = provider
         self.codec = BallistaCodec(provider=provider)
+        # eager shuffle (docs/shuffle.md): readers poll the scheduler for
+        # published map-output locations through a lazily-dialed channel;
+        # the task loops (PollLoop/ExecutorServer) stamp the address and
+        # close the channel on stop
+        self.scheduler_addr = scheduler_addr
+        from ballista_tpu.analysis.witness import make_lock
+
+        self._locations_lock = make_lock("Executor._locations_lock")
+        self._locations_channel = None
+        self._locations_stub = None
+        self._locations_closed = False
         # re-verify decoded stage plans before running them (catches serde
         # drift between scheduler and executor builds). StandaloneCluster
         # turns this off: in-proc, the scheduler just verified the same
@@ -61,6 +73,87 @@ class Executor:
         from ballista_tpu.executor.metrics import LoggingMetricsCollector
 
         self.metrics_collector = metrics_collector or LoggingMetricsCollector()
+
+    # -- eager-shuffle location polling (docs/shuffle.md) --------------------
+    def _locations_client(self):
+        """Scheduler stub for GetShuffleLocations, dialed lazily on the
+        first eager poll. The dial happens OUTSIDE the lock (racelint
+        blocking-under-lock); a store-race loser's channel is closed."""
+        with self._locations_lock:
+            if self._locations_closed:
+                return None
+            stub = self._locations_stub
+        if stub is not None or not self.scheduler_addr:
+            return stub
+        ch = grpc.insecure_channel(self.scheduler_addr)
+        stub = scheduler_stub(ch)
+        extra = None
+        with self._locations_lock:
+            if self._locations_closed:
+                # stop() ran while we dialed: storing now would leak a
+                # channel nobody will ever close again
+                stub, extra = None, ch
+            elif self._locations_stub is not None:
+                stub, extra = self._locations_stub, ch
+            else:
+                self._locations_channel = ch
+                self._locations_stub = stub
+        if extra is not None:
+            try:
+                extra.close()
+            except Exception:  # noqa: BLE001
+                pass
+        return stub
+
+    def shuffle_locations(self, job_id: str, stage_id: int, partition: int):
+        """TaskContext.shuffle_locations implementation: one
+        GetShuffleLocations poll, decoded into a ShuffleLocationsView.
+        A transiently unreachable scheduler reads as "no progress yet"
+        (the reader keeps waiting under its own bounded deadline) rather
+        than "stage gone" — only an explicit failed response is
+        terminal."""
+        from ballista_tpu.executor.reader import ShuffleLocationsView
+        from ballista_tpu.serde import loc_from_proto
+
+        stub = self._locations_client()
+        if stub is None:
+            return None
+        try:
+            res = stub.GetShuffleLocations(
+                pb.FetchPartition(
+                    job_id=job_id, stage_id=stage_id, partition_id=partition
+                ),
+                timeout=10.0,
+            )
+        except grpc.RpcError as e:
+            log.warning("GetShuffleLocations poll failed: %s", e)
+            return ShuffleLocationsView([], 0, False, False)
+        return ShuffleLocationsView(
+            locations=[
+                (int(mt), loc_from_proto(loc))
+                for mt, loc in zip(res.map_task, res.locations)
+            ],
+            tasks_done_prefix=int(res.tasks_done_prefix),
+            complete=bool(res.complete),
+            failed=bool(res.failed),
+        )
+
+    def close_locations_client(self) -> None:
+        """Close the eager-poll channel (its sockets and callback threads
+        would otherwise leak across start/stop cycles — the shutdown
+        hygiene tests count threads). Latches CLOSED: an in-flight task
+        polling after this must get None, not re-dial a channel nobody
+        will close."""
+        with self._locations_lock:
+            ch = self._locations_channel
+            self._locations_channel = None
+            self._locations_stub = None
+            self._locations_closed = True
+        if ch is not None:
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001
+                pass
 
     def execute_shuffle_write(
         self, task: pb.TaskDefinition
@@ -144,6 +237,9 @@ class Executor:
             session_id=task.session_id,
             job_id=task.task_id.job_id,
             work_dir=self.work_dir,
+            shuffle_locations=(
+                self.shuffle_locations if self.scheduler_addr else None
+            ),
         )
         self._plan_cache.update(attempt_cache)
         self.metrics_collector.record_stage(
@@ -192,6 +288,10 @@ class PollLoop:
     ):
         self.executor = executor
         self.scheduler_addr = scheduler_addr
+        # eager shuffle: the executor core polls published map-output
+        # locations from the same scheduler this loop polls work from
+        if not executor.scheduler_addr:
+            executor.scheduler_addr = scheduler_addr
         self.flight_host = flight_host
         self.flight_port = flight_port
         task_slots = effective_task_slots(task_slots)
@@ -211,6 +311,7 @@ class PollLoop:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        self.executor.close_locations_client()
 
     def _metadata(self) -> pb.ExecutorMetadata:
         return pb.ExecutorMetadata(

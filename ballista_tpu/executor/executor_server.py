@@ -59,6 +59,10 @@ class ExecutorServer:
     ) -> None:
         self.executor = executor
         self.scheduler_addr = scheduler_addr
+        # eager shuffle: the executor core polls published map-output
+        # locations from the same scheduler this server reports to
+        if not executor.scheduler_addr:
+            executor.scheduler_addr = scheduler_addr
         self.flight_host = flight_host
         self.flight_port = flight_port
         task_slots = effective_task_slots(task_slots)
@@ -207,6 +211,11 @@ class ExecutorServer:
             t.join(timeout=5)
             if t.is_alive():
                 stragglers.append(t.name)
+        # AFTER the runner join: a runner mid-eager-task must not see the
+        # poll channel closed and re-dial one nobody would ever close
+        # (close_locations_client also latches against exactly that race
+        # for stragglers that outlived the join timeout)
+        self.executor.close_locations_client()
         if self._grpc_server is not None:
             ev = self._grpc_server.stop(grace=None)
             if ev is not None:

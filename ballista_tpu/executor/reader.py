@@ -2,15 +2,41 @@
 
 ref ballista/rust/core/src/execution_plans/shuffle_reader.rs:44-294. For its
 output partition p it fetches every mapped shuffle file (one per upstream
-task that produced rows for p): local paths read directly; remote ones
-fetched over Arrow Flight (`do_get` with a FetchPartition ticket — ref
-client.rs:75-130 <-> flight_service.rs:79-117).
+task that produced rows for p): local paths read directly (zero-copy via
+``pa.memory_map``); remote ones fetched over Arrow Flight (`do_get` with a
+FetchPartition ticket — ref client.rs:75-130 <-> flight_service.rs:79-117).
+
+Streaming pipeline (docs/shuffle.md):
+
+- **Overlapped fetch**: up to ``ballista.tpu.shuffle_fetch_concurrency``
+  upstream locations are pulled AT ONCE, each by a pool worker into a small
+  bounded batch queue, while the consumer drains locations strictly in
+  order — network/disk overlaps device compute, and the yield order (hence
+  every downstream float reduction) is identical to the sequential loop, so
+  results stay bit-exact vs the ``<= 1`` sequential baseline.
+- **Eager mode** (``ballista.tpu.eager_shuffle``): instead of a location
+  list baked in at stage promotion, the reader POLLS the scheduler
+  (GetShuffleLocations) for map outputs as they are published, consuming
+  them in map-task order — the exact order the barriered resolution would
+  have produced. "Not yet published" waits (bounded by
+  ``ballista.tpu.eager_wait_s``); "location lost" surfaces as the same
+  typed ShuffleFetchError that drives lineage recompute.
+
+Error taxonomy is unchanged from the sequential reader: per-location
+retry/backoff lives in the Flight client, and what escapes is a typed
+:class:`ShuffleFetchError` naming the producing (executor, stage,
+partition) so the scheduler can recompute lost map output.
 """
 
 from __future__ import annotations
 
+import collections
+import dataclasses
 import os
-from typing import Iterator
+import queue as _queue
+import threading
+import time as _time
+from typing import Callable, Iterator
 
 import pyarrow as pa
 import pyarrow.ipc as paipc
@@ -18,7 +44,7 @@ import pyarrow.ipc as paipc
 from ballista_tpu.columnar.arrow_interop import table_from_arrow
 from ballista_tpu.columnar.batch import DeviceBatch
 from ballista_tpu.datatypes import Schema
-from ballista_tpu.errors import ShuffleFetchError
+from ballista_tpu.errors import ExecutionError, ShuffleFetchError
 from ballista_tpu.exec.base import (
     ExecutionPlan,
     TaskContext,
@@ -28,12 +54,30 @@ from ballista_tpu.scheduler_types import PartitionLocation
 
 BATCH_ROWS = 1 << 17
 
+# Record batches buffered per in-flight location (the prefetch_slices
+# double-buffer idiom at batch granularity): deep enough to keep a worker
+# busy while the consumer flushes to device, small enough that host
+# residency stays ~concurrency * depth batches.
+_QUEUE_DEPTH = 4
+
+
+def _open_local_file(path: str):
+    """Arrow IPC reader over a memory map: uncompressed shuffle files are
+    then consumed zero-copy (batches alias the page cache instead of being
+    read into fresh host buffers); compressed ones decode per batch."""
+    return paipc.open_file(pa.memory_map(path))
+
 
 def fetch_partition_table(loc: PartitionLocation) -> pa.Table:
-    """One shuffle file -> Arrow table (local fast path, else Flight)."""
+    """One shuffle file -> Arrow table. Local files come back zero-copy off
+    a memory map (the table aliases the page cache — no heap copy of the
+    partition); remote ones are assembled from the streamed Flight batch
+    path, so nothing buffers the whole partition ON TOP of the table the
+    caller asked for. Shuffle readers should prefer
+    :func:`fetch_partition_batches` and never materialize at all."""
     if os.path.exists(loc.path):
         try:
-            with paipc.open_file(loc.path) as r:
+            with _open_local_file(loc.path) as r:
                 return r.read_all()
         except (pa.ArrowInvalid, pa.ArrowIOError, OSError) as e:
             raise _local_fetch_error(loc, e) from e
@@ -63,6 +107,8 @@ def fetch_partition_batches(
     retries: int | None = None,
     backoff_ms: int | None = None,
     timeout_s: float | None = None,
+    compression: str = "",
+    local_fastpath: bool = True,
 ) -> Iterator[pa.RecordBatch]:
     """One shuffle file -> record-batch stream; peak memory is a batch,
     not the partition (ref shuffle_reader.rs streams batches through the
@@ -72,19 +118,46 @@ def fetch_partition_batches(
     are retried inside the Flight client; what escapes here is a typed
     ShuffleFetchError naming the producing (executor, stage, partition) so
     the scheduler can recompute lost map output. Local-file corruption is
-    classified the same way — non-transient, recompute-recoverable."""
-    if os.path.exists(loc.path):
+    classified the same way — non-transient, recompute-recoverable.
+
+    ``compression`` asks the SERVING executor to compress the Flight
+    stream with that codec (files are self-describing, so the local path
+    ignores it)."""
+    if local_fastpath and os.path.exists(loc.path):
+        from ballista_tpu.testing import faults
+
         _inject_local_fetch_faults(loc, retries, backoff_ms)
+        inj = faults.active()
         try:
-            with paipc.open_file(loc.path) as r:
+            with _open_local_file(loc.path) as r:
                 for i in range(r.num_record_batches):
+                    if inj is not None:
+                        # producer_kill mirrors the Flight service's
+                        # injection point on the LOCAL fast path (standalone
+                        # clusters share a filesystem, so chaos tests would
+                        # never reach the remote hook): the producer "dies"
+                        # after i batches were already consumed
+                        try:
+                            inj.on_serve_batch(
+                                loc.job_id, loc.stage_id, loc.partition, i,
+                                path=loc.path,
+                            )
+                        except faults.InjectedFault as e:
+                            raise ShuffleFetchError(
+                                str(e),
+                                job_id=loc.job_id,
+                                stage_id=loc.stage_id,
+                                partition=loc.partition,
+                                executor_id=loc.executor_id,
+                                transient=False,
+                            ) from e
                     yield r.get_batch(i)
             return
         except (pa.ArrowInvalid, pa.ArrowIOError, OSError) as e:
             raise _local_fetch_error(loc, e) from e
     from ballista_tpu.client.flight import fetch_partition_batches as remote
 
-    yield from remote(loc, retries, backoff_ms, timeout_s)
+    yield from remote(loc, retries, backoff_ms, timeout_s, compression)
 
 
 def _inject_local_fetch_faults(
@@ -101,8 +174,6 @@ def _inject_local_fetch_faults(
     inj = faults.active()
     if inj is None:
         return
-    import time as _time
-
     from ballista_tpu.client.flight import (
         DEFAULT_FETCH_BACKOFF_MS,
         DEFAULT_FETCH_RETRIES,
@@ -131,15 +202,300 @@ def _inject_local_fetch_faults(
             _time.sleep(backoff_s(loc, attempt, backoff))
 
 
+# ---------------------------------------------------------------------------
+# location feeds: where the reader's upstream locations come from
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ShuffleLocationsView:
+    """One GetShuffleLocations poll, decoded (executor.py builds these from
+    the proto): published locations tagged with their producing map-task
+    index, the contiguous completed-task prefix, and terminal flags."""
+
+    locations: list[tuple[int, PartitionLocation]]
+    tasks_done_prefix: int
+    complete: bool
+    failed: bool
+
+
+class _StaticFeed:
+    """Barriered mode: the location list baked in at stage promotion."""
+
+    def __init__(self, locs: list[PartitionLocation]):
+        self._locs = collections.deque(locs)
+
+    def next_ready(self) -> PartitionLocation | None:
+        return self._locs.popleft() if self._locs else None
+
+    def next_blocking(self) -> PartitionLocation | None:
+        return self.next_ready()
+
+
+class _EagerFeed:
+    """Eager mode: poll the scheduler for published map outputs, yielding
+    locations in MAP-TASK ORDER — exactly the order the barriered
+    resolution produces — so eager results stay bit-exact vs barriered.
+
+    A location is yielded only once its map-task index is below the
+    completed-task prefix (or the stage committed): everything yielded is
+    a closed, fully-written file. The prefix may SHRINK under lineage
+    recovery (a completed task re-opened); already-yielded indices are
+    never re-yielded — the data consumed from the original file is the
+    same bytes a bit-exact recompute would produce, and a fetch that dies
+    mid-stream escalates through the normal ShuffleFetchError path."""
+
+    def __init__(self, ctx: TaskContext, job_id: str, stage_id: int,
+                 partition: int, metrics):
+        if ctx.shuffle_locations is None:
+            raise ExecutionError(
+                "eager ShuffleReaderExec requires a scheduler-connected "
+                "executor (TaskContext.shuffle_locations); eager plans "
+                "are only dispatched by the scheduler"
+            )
+        self._poll: Callable = ctx.shuffle_locations
+        self.job_id = job_id
+        self.stage_id = stage_id
+        self.partition = partition
+        self._metrics = metrics
+        self._interval_s = ctx.config.eager_poll_ms() / 1000.0
+        self._wait_s = ctx.config.eager_wait_s()
+        self._pending: collections.deque = collections.deque()
+        self._next_map = 0
+        self._complete = False
+        self._last_poll = 0.0
+
+    def _lost(self, msg: str) -> ShuffleFetchError:
+        return ShuffleFetchError(
+            msg,
+            job_id=self.job_id,
+            stage_id=self.stage_id,
+            partition=self.partition,
+            executor_id="",
+            transient=True,
+        )
+
+    def _refresh(self) -> None:
+        view: ShuffleLocationsView | None = self._poll(
+            self.job_id, self.stage_id, self.partition
+        )
+        self._last_poll = _time.monotonic()
+        self._metrics.add("eager_polls")
+        if view is None or view.failed:
+            raise self._lost(
+                f"eager shuffle source stage {self.stage_id} of job "
+                f"{self.job_id} is gone (job torn down or stage removed)"
+            )
+        upto = None if view.complete else view.tasks_done_prefix
+        ready = sorted(
+            (mt, loc)
+            for mt, loc in view.locations
+            if mt >= self._next_map and (upto is None or mt < upto)
+        )
+        for mt, loc in ready:
+            self._pending.append(loc)
+            self._next_map = mt + 1
+        if upto is not None:
+            # empty producers below the prefix publish no file; skip them
+            self._next_map = max(self._next_map, upto)
+        else:
+            self._complete = True
+
+    def next_ready(self) -> PartitionLocation | None:
+        """Non-blocking: a published location if one is due, else None.
+        Polls are rate-limited to the configured cadence so the overlap
+        top-up on every consumed batch cannot turn into an RPC storm."""
+        if not self._pending and not self._complete and (
+            _time.monotonic() - self._last_poll >= self._interval_s
+        ):
+            self._refresh()
+        return self._pending.popleft() if self._pending else None
+
+    def next_blocking(self) -> PartitionLocation | None:
+        """The next location in map-task order, waiting (bounded) for the
+        producer to publish it; None once the stage committed and every
+        published location was yielded."""
+        start = _time.monotonic()
+        while True:
+            if self._pending:
+                return self._pending.popleft()
+            if self._complete:
+                return None
+            self._refresh()
+            if self._pending or self._complete:
+                continue
+            if self._wait_s and _time.monotonic() - start > self._wait_s:
+                # [eager-wait-timeout] is machine-parsed by the scheduler
+                # (apply_task_statuses): giving up on a SLOW producer must
+                # requeue this task WITHOUT consuming a bounded attempt —
+                # charging it would fail healthy jobs whose map tasks just
+                # take longer than the deadline, something barriered mode
+                # would have waited out. The requeue loop converges: each
+                # round only soaks an otherwise-idle slot, and ends when
+                # the producer publishes (or the job fails on its own).
+                raise self._lost(
+                    f"[eager-wait-timeout] eager shuffle wait deadline "
+                    f"({self._wait_s:g}s) exceeded for stage "
+                    f"{self.stage_id} partition {self.partition} "
+                    f"(map tasks >= {self._next_map} unpublished)"
+                )
+            self._metrics.add("eager_waits")
+            _time.sleep(self._interval_s)
+
+
+# ---------------------------------------------------------------------------
+# overlapped fetch pipeline
+# ---------------------------------------------------------------------------
+
+_DONE = object()
+
+
+class _Err:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def _pump_put(q: _queue.Queue, item, stop: threading.Event) -> bool:
+    """Bounded, cancellation-aware handoff from a fetch worker to the
+    consuming generator: the put blocks only in short slices so an
+    abandoned consumer (GeneratorExit sets ``stop``) can never leave a
+    worker wedged against a full queue."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except _queue.Full:
+            continue
+    return False
+
+
+def _iter_location_batches(
+    feed, fetch_one: Callable, concurrency: int, metrics
+) -> Iterator[pa.RecordBatch]:
+    """Merge upstream locations into one record-batch stream.
+
+    ``concurrency <= 1``: the sequential baseline — one location at a
+    time, exactly the pre-overlap loop. Otherwise up to ``concurrency``
+    locations are fetched at once by pool workers, each into a bounded
+    queue, while batches are YIELDED strictly in location order (location
+    i's batches all precede location i+1's), so the merged stream is
+    byte-identical to the sequential one. A location's fetch error is
+    raised at the point the consumer reaches that location — the same
+    position the sequential loop would raise it."""
+    if concurrency <= 1:
+        while True:
+            loc = feed.next_blocking()
+            if loc is None:
+                return
+            got_any = False
+            it = fetch_one(loc)
+            while True:
+                with metrics.time("fetch_time"):
+                    rb = next(it, None)
+                if rb is None:
+                    break
+                got_any = True
+                metrics.add("fetched_bytes", rb.nbytes)
+                yield rb
+            if got_any:
+                metrics.add("fetched_batches")
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    stop = threading.Event()
+    window: collections.deque = collections.deque()
+    ex = ThreadPoolExecutor(
+        max_workers=concurrency, thread_name_prefix="shuffle-fetch"
+    )
+
+    def pump(loc: PartitionLocation, q: _queue.Queue) -> None:
+        try:
+            for rb in fetch_one(loc):
+                if not _pump_put(q, rb, stop):
+                    return
+            _pump_put(q, _DONE, stop)
+        except BaseException as e:  # noqa: BLE001 — relayed to the consumer
+            _pump_put(q, _Err(e), stop)
+
+    def start_fetch(loc: PartitionLocation) -> None:
+        q: _queue.Queue = _queue.Queue(maxsize=_QUEUE_DEPTH)
+        window.append((loc, q))
+        ex.submit(pump, loc, q)
+
+    def top_up() -> None:
+        while len(window) < concurrency:
+            loc = feed.next_ready()
+            if loc is None:
+                return
+            start_fetch(loc)
+
+    try:
+        top_up()
+        while True:
+            if not window:
+                loc = feed.next_blocking()
+                if loc is None:
+                    return
+                start_fetch(loc)
+                top_up()
+            _loc, q = window[0]
+            got_any = False
+            while True:
+                try:
+                    item = q.get_nowait()
+                    buffered = True
+                except _queue.Empty:
+                    buffered = False
+                    with metrics.time("fetch_time"):
+                        item = q.get()
+                if item is _DONE:
+                    break
+                if isinstance(item, _Err):
+                    raise item.exc
+                # counted only for real record batches — sentinels would
+                # skew the overlap ratio by one entry per location. A miss
+                # means the consumer genuinely waited on the network: the
+                # time a deeper overlap window could still hide.
+                metrics.add(
+                    "fetch_overlap_hits" if buffered
+                    else "fetch_overlap_misses"
+                )
+                got_any = True
+                metrics.add("fetched_bytes", item.nbytes)
+                yield item
+                top_up()
+            window.popleft()
+            if got_any:
+                metrics.add("fetched_batches")
+            top_up()
+    finally:
+        # GeneratorExit from an early-stopping consumer lands here too:
+        # stop lets blocked workers bail out of their bounded puts, then
+        # the pool join guarantees no fetch thread outlives the task
+        stop.set()
+        ex.shutdown(wait=True, cancel_futures=True)
+
+
 class ShuffleReaderExec(ExecutionPlan):
+    """``eager`` plans (ballista.tpu.eager_shuffle) carry the producing
+    (job_id, stage_id) instead of resolved locations and poll the
+    scheduler; ``partition_locations`` then only sizes the output
+    partitioning (one empty list per output partition)."""
+
     def __init__(
         self,
         partition_locations: list[list[PartitionLocation]],
         schema: Schema,
+        job_id: str = "",
+        stage_id: int = 0,
+        eager: bool = False,
     ) -> None:
         super().__init__()
         self.partition_locations = [list(p) for p in partition_locations]
         self._schema = schema
+        self.job_id = job_id
+        self.stage_id = stage_id
+        self.eager = eager
 
     def schema(self) -> Schema:
         return self._schema
@@ -148,6 +504,11 @@ class ShuffleReaderExec(ExecutionPlan):
         return UnknownPartitioning(max(1, len(self.partition_locations)))
 
     def describe(self) -> str:
+        if self.eager:
+            return (
+                f"ShuffleReaderExec: eager stage={self.stage_id}, "
+                f"{len(self.partition_locations)} partitions"
+            )
         n = sum(len(p) for p in self.partition_locations)
         return (
             f"ShuffleReaderExec: {len(self.partition_locations)} partitions, "
@@ -158,10 +519,32 @@ class ShuffleReaderExec(ExecutionPlan):
         if partition >= len(self.partition_locations):
             yield DeviceBatch.empty(self._schema)
             return
-        locs = self.partition_locations[partition]
-        if not locs:
-            yield DeviceBatch.empty(self._schema)
-            return
+        # fetch resilience knobs travel with the session config; exhausted
+        # retries surface as a typed ShuffleFetchError that fails this task
+        # and routes the scheduler into lost-shuffle recompute
+        retries = ctx.config.fetch_retries()
+        backoff_ms = ctx.config.fetch_backoff_ms()
+        timeout_s = ctx.config.fetch_timeout_s()
+        compression = ctx.config.shuffle_compression()
+        local_fastpath = ctx.config.shuffle_local_fastpath()
+
+        def fetch_one(loc: PartitionLocation) -> Iterator[pa.RecordBatch]:
+            return fetch_partition_batches(
+                loc, retries, backoff_ms, timeout_s, compression,
+                local_fastpath,
+            )
+
+        if self.eager:
+            feed = _EagerFeed(
+                ctx, self.job_id, self.stage_id, partition, self.metrics
+            )
+        else:
+            locs = self.partition_locations[partition]
+            if not locs:
+                yield DeviceBatch.empty(self._schema)
+                return
+            feed = _StaticFeed(locs)
+
         any_rows = False
         batch_rows = min(BATCH_ROWS, ctx.config.tpu_batch_rows())
         # Streamed re-chunking: record batches accumulate only up to the
@@ -179,34 +562,18 @@ class ShuffleReaderExec(ExecutionPlan):
             # int32/int64 between files and double downstream compiles)
             return table_from_arrow(t, batch_rows, frozenset())
 
-        # fetch resilience knobs travel with the session config; exhausted
-        # retries surface as a typed ShuffleFetchError that fails this task
-        # and routes the scheduler into lost-shuffle recompute
-        retries = ctx.config.fetch_retries()
-        backoff_ms = ctx.config.fetch_backoff_ms()
-        timeout_s = ctx.config.fetch_timeout_s()
-        for loc in locs:
-            it = fetch_partition_batches(loc, retries, backoff_ms, timeout_s)
-            got_any = False
-            while True:
-                # only the pull is timed: flushing to device must not be
-                # billed as fetch, and the timer must close before a yield
-                # suspends this generator
-                with self.metrics.time("fetch_time"):
-                    rb = next(it, None)
-                if rb is None:
-                    break
-                got_any = True
-                if rb.num_rows == 0:
-                    continue
-                any_rows = True
-                pending.append(rb)
-                pending_rows += rb.num_rows
-                if pending_rows >= batch_rows:
-                    yield from flush()
-                    pending_rows = 0
-            if got_any:
-                self.metrics.add("fetched_batches")
+        concurrency = ctx.config.shuffle_fetch_concurrency()
+        for rb in _iter_location_batches(
+            feed, fetch_one, concurrency, self.metrics
+        ):
+            if rb.num_rows == 0:
+                continue
+            any_rows = True
+            pending.append(rb)
+            pending_rows += rb.num_rows
+            if pending_rows >= batch_rows:
+                yield from flush()
+                pending_rows = 0
         if pending:
             yield from flush()
         if not any_rows:

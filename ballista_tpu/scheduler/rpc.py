@@ -23,6 +23,9 @@ SCHEDULER_METHODS = {
     "GetFileMetadata": (pb.GetFileMetadataParams, pb.GetFileMetadataResult),
     "ExecuteQuery": (pb.ExecuteQueryParams, pb.ExecuteQueryResult),
     "GetJobStatus": (pb.GetJobStatusParams, pb.GetJobStatusResult),
+    # eager shuffle (docs/shuffle.md): executors poll published map-output
+    # locations of a still-running producer stage
+    "GetShuffleLocations": (pb.FetchPartition, pb.ShuffleLocationsResult),
 }
 
 EXECUTOR_METHODS = {

@@ -105,6 +105,14 @@ class JobInfo:
     resolved_plan_bytes: dict[int, bytes] = dataclasses.field(
         default_factory=dict
     )
+    # eager-shuffle (docs/shuffle.md): session flag snapshot + serialized
+    # EAGER resolutions per stage. Eager plans carry no locations (readers
+    # poll), so unlike resolved_plan_bytes they are never invalidated by
+    # lost-shuffle recovery.
+    eager: bool = False
+    eager_plan_bytes: dict[int, bytes] = dataclasses.field(
+        default_factory=dict
+    )
     # retry policy snapshot (session config at submission) + visibility
     # counters that outlive the per-stage bookkeeping (torn down at job
     # completion): bounded task retries + lost-shuffle recompute rounds
@@ -479,6 +487,7 @@ class SchedulerServer:
             self._on_job_failed(job_id, f"planning failed: {e}")
             return
         job.max_attempts = cfg.task_max_attempts()
+        job.eager = cfg.eager_shuffle()
         deps: dict[int, set[int]] = {}
         for stage in stages:
             job.stages[stage.stage_id] = stage
@@ -557,6 +566,18 @@ class SchedulerServer:
             plan
         ).SerializeToString()
 
+    def _executor_endpoint(self, executor_id: str) -> tuple[str, int]:
+        """(host, port) a reader should dial for an executor's shuffle
+        output — the single resolution used by BOTH the barriered
+        (_stage_output_locations) and eager (shuffle_locations_proto)
+        paths, so their location construction cannot drift. An unknown
+        executor resolves to localhost:0: the location still carries the
+        local filesystem path, which colocated readers can consume."""
+        meta_exec = self.executor_manager.get_executor_metadata(executor_id)
+        host = meta_exec.host if meta_exec else "localhost"
+        port = meta_exec.port if meta_exec else 0
+        return host, port
+
     def _stage_output_locations(
         self, job_id: str, stage_id: int, n_out: int
     ) -> list[list[PartitionLocation]]:
@@ -564,9 +585,7 @@ class SchedulerServer:
         for (task_idx, executor_id, metas) in (
             self.stage_manager.completed_partitions(job_id, stage_id)
         ):
-            meta_exec = self.executor_manager.get_executor_metadata(executor_id)
-            host = meta_exec.host if meta_exec else "localhost"
-            port = meta_exec.port if meta_exec else 0
+            host, port = self._executor_endpoint(executor_id)
             for m in metas:
                 locs[m.partition_id].append(
                     PartitionLocation(
@@ -734,12 +753,53 @@ class SchedulerServer:
         log.error("job %s failed: %s", job_id, error)
 
     # -- task handout (pull mode; ref grpc.rs:121-147) -----------------------
+    def _pick_eager_task(self, executor_id: str):
+        """Eager-shuffle handout, tried only after assign_next_task found
+        no runnable work: a pending consumer stage whose producers all
+        have committed output may start fetching early (docs/shuffle.md).
+        Soaking otherwise-idle slots is what makes this deadlock-free —
+        any producer task that becomes PENDING again does so by freeing a
+        slot (failure) or by lost-shuffle invalidation, and the next free
+        slot always prefers runnable stages over eager ones."""
+        with self._lock:
+            eager_jobs = {
+                jid
+                for jid, j in self.jobs.items()
+                if j.status == "running" and j.eager
+            }
+        if not eager_jobs:
+            return None
+        return self.stage_manager.assign_next_eager_task(
+            executor_id, eager_jobs
+        )
+
+    def _eager_plan_bytes(self, job, job_id: str, stage_id: int) -> bytes:
+        """Serialized eager resolution of one stage (cached: it depends
+        only on the pristine template, never on locations, so recovery
+        cannot invalidate it). Caller holds the server lock."""
+        plan_bytes = job.eager_plan_bytes.get(stage_id)
+        if plan_bytes is None:
+            from ballista_tpu.distributed_plan import resolve_shuffles_eager
+
+            plan = resolve_shuffles_eager(
+                job.stages[stage_id].plan, job_id
+            )
+            plan_bytes = self.codec.physical_to_proto(
+                plan
+            ).SerializeToString()
+            job.eager_plan_bytes[stage_id] = plan_bytes
+        return plan_bytes
+
     def next_task(self, executor_id: str) -> pb.TaskDefinition | None:
         # atomic pick+mark inside the stage manager: two concurrent
         # PollWork threads previously could both see the same partition
         # PENDING (the second RUNNING mark was silently dropped as an
         # illegal RUNNING->RUNNING hop) and both run the task
+        eager_pick = False
         picked = self.stage_manager.assign_next_task(executor_id)
+        if picked is None:
+            picked = self._pick_eager_task(executor_id)
+            eager_pick = picked is not None
         if picked is None:
             return None
         job_id, stage_id, partition, attempt, events = picked
@@ -753,8 +813,26 @@ class SchedulerServer:
             return None
         failure: JobFailed | None = None
         with self._lock:
-            plan_bytes = job.resolved_plan_bytes.get(stage_id)
-            if plan_bytes is None:
+            if eager_pick:
+                try:
+                    plan_bytes = self._eager_plan_bytes(
+                        job, job_id, stage_id
+                    )
+                except Exception as e:  # noqa: BLE001 — deterministic
+                    self.stage_manager.update_task_status(
+                        task_id, TaskState.PENDING
+                    )
+                    failure = JobFailed(
+                        job_id, stage_id,
+                        f"eager stage resolution failed: {e}",
+                    )
+                    log.exception(
+                        "eager stage %s/%s resolution failed",
+                        job_id, stage_id,
+                    )
+            else:
+                plan_bytes = job.resolved_plan_bytes.get(stage_id)
+            if not eager_pick and plan_bytes is None:
                 # lazy (re-)resolution under the server lock, serialized
                 # against _on_shuffle_lost: recovery may have demoted this
                 # stage and dropped its resolved bytes between the
@@ -1009,8 +1087,14 @@ class SchedulerServer:
                     # only skip the attempt charge when recovery actually
                     # re-opened something: otherwise (unparseable executor,
                     # repeated loss already handled) the normal bounded
-                    # path keeps the failure from looping forever
-                    count_attempt = not recovered
+                    # path keeps the failure from looping forever.
+                    # Exception: an eager reader giving up on a SLOW (not
+                    # lost) producer (docs/shuffle.md) — charging that
+                    # would fail healthy jobs barriered mode would have
+                    # waited out; the requeue is bounded by producer
+                    # progress, exactly like barriered waiting.
+                    eager_timeout = "[eager-wait-timeout]" in error
+                    count_attempt = not (recovered or eager_timeout)
                 events = self.stage_manager.update_task_status(
                     tid,
                     TaskState.FAILED,
@@ -1026,6 +1110,46 @@ class SchedulerServer:
                 events = []
             for e in events:
                 self.event_loop.post(e)
+
+    def shuffle_locations_proto(
+        self, job_id: str, stage_id: int, partition: int
+    ) -> pb.ShuffleLocationsResult:
+        """GetShuffleLocations (eager shuffle, docs/shuffle.md): the
+        published map outputs of one producing stage feeding one output
+        partition, plus the completed-task prefix and commit flag.
+        ``failed`` tells the polling reader to stop waiting: the job is
+        gone/failed, or the stage bookkeeping was torn down."""
+        res = pb.ShuffleLocationsResult()
+        job = self._get_job(job_id)
+        if job is None or job.status not in ("queued", "running"):
+            res.failed = True
+            return res
+        snap = self.stage_manager.shuffle_locations(
+            job_id, stage_id, partition
+        )
+        if snap is None:
+            res.failed = True
+            return res
+        entries, prefix, complete = snap
+        res.tasks_done_prefix = prefix
+        res.complete = complete
+        for task_idx, executor_id, m in entries:
+            host, port = self._executor_endpoint(executor_id)
+            res.map_task.append(task_idx)
+            res.locations.append(
+                loc_to_proto(
+                    PartitionLocation(
+                        job_id=job_id,
+                        stage_id=stage_id,
+                        partition=partition,
+                        executor_id=executor_id,
+                        host=host,
+                        port=port,
+                        path=m.path,
+                    )
+                )
+            )
+        return res
 
     def job_status_proto(self, job_id: str) -> pb.JobStatus:
         job = self._get_job(job_id)
@@ -1232,6 +1356,13 @@ class SchedulerGrpcServicer:
     def GetJobStatus(self, request, context):
         return pb.GetJobStatusResult(
             status=self.s.job_status_proto(request.job_id)
+        )
+
+    def GetShuffleLocations(self, request, context):
+        """Eager-shuffle location poll (request reuses the FetchPartition
+        vocabulary: job, producing stage, output partition)."""
+        return self.s.shuffle_locations_proto(
+            request.job_id, request.stage_id, request.partition_id
         )
 
 

@@ -260,6 +260,96 @@ class StageManager:
             attempt = self.task_attempt(job_id, stage_id, partition)
             return job_id, stage_id, partition, attempt, events
 
+    def assign_next_eager_task(
+        self, executor_id: str, eager_jobs: set[str]
+    ) -> tuple[str, int, int, int, list["StageEvent"]] | None:
+        """Eager-shuffle handout (docs/shuffle.md): atomically pick a task
+        from a PENDING consumer stage whose producers are all in flight
+        with at least one committed map output, and mark it RUNNING.
+        Called only when :meth:`assign_next_task` found no runnable work,
+        so eager consumers never compete with normal tasks for slots —
+        they soak otherwise-idle capacity with early fetch work.
+
+        ``eager_jobs``: jobs whose session enabled ballista.tpu.
+        eager_shuffle (the server snapshots the flag at submission).
+        Promotion stays the commit point: the stage remains PENDING and is
+        promoted exactly as in barriered mode once every producer
+        completes."""
+        with self._lock:
+            candidates = []
+            for key in self._pending:
+                job_id, stage_id = key
+                if job_id not in eager_jobs:
+                    continue
+                stage = self._stages.get(key)
+                if stage is None or not any(
+                    t.state == TaskState.PENDING for t in stage.tasks
+                ):
+                    continue
+                producers = [
+                    child
+                    for (jid, child), parents in self._dependencies.items()
+                    if jid == job_id and stage_id in parents
+                ]
+                if not producers:
+                    continue
+                ready = True
+                for p in producers:
+                    ps = self._stages.get((job_id, p))
+                    if ps is None or not any(
+                        t.state == TaskState.COMPLETED for t in ps.tasks
+                    ):
+                        ready = False
+                        break
+                if ready:
+                    candidates.append(key)
+            if not candidates:
+                return None
+            job_id, stage_id = random.choice(candidates)
+            pending = self.fetch_pending_tasks(
+                job_id, stage_id, 1, executor_id=executor_id
+            )
+            if not pending:
+                return None
+            partition = pending[0]
+            events = self.update_task_status(
+                PartitionId(job_id, stage_id, partition),
+                TaskState.RUNNING,
+                executor_id=executor_id,
+            )
+            attempt = self.task_attempt(job_id, stage_id, partition)
+            return job_id, stage_id, partition, attempt, events
+
+    def shuffle_locations(
+        self, job_id: str, stage_id: int, partition: int
+    ) -> tuple[list[tuple[int, str, ShuffleWritePartitionMeta]], int, bool] | None:
+        """Eager-poll snapshot for GetShuffleLocations: the published
+        (COMPLETED) map outputs of one stage feeding ``partition``, as
+        ``(entries, tasks_done_prefix, complete)`` where entries are
+        ``(map task index, executor_id, meta)`` in task order and the
+        prefix counts leading COMPLETED tasks (lineage recovery may
+        shrink it; readers never consume beyond it pre-commit). None when
+        the stage bookkeeping is gone (job finished or torn down)."""
+        with self._lock:
+            stage = self._stages.get((job_id, stage_id))
+            if stage is None:
+                return None
+            entries = []
+            prefix = 0
+            counting = True
+            complete = True
+            for i, t in enumerate(stage.tasks):
+                if t.state == TaskState.COMPLETED:
+                    if counting:
+                        prefix = i + 1
+                    for m in t.partitions:
+                        if m.partition_id == partition:
+                            entries.append((i, t.executor_id, m))
+                else:
+                    counting = False
+                    complete = False
+            return entries, prefix, complete
+
     def fetch_schedulable_stage(self) -> tuple[str, int] | None:
         """A random running stage with pending tasks (ref :300-324 — random
         pick avoids head-of-line blocking across jobs)."""

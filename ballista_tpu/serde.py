@@ -824,6 +824,12 @@ class BallistaCodec:
                         for locs in plan.partition_locations
                     ],
                     schema=schema_to_proto(plan.schema()),
+                    # eager mode: locations are polled, not baked in
+                    # (proto3 skips the defaults, keeping barriered
+                    # encodings byte-identical to the pre-eager wire)
+                    job_id=plan.job_id,
+                    stage_id=plan.stage_id,
+                    eager=plan.eager,
                 )
             )
         if isinstance(plan, UnresolvedShuffleExec):
@@ -1079,6 +1085,9 @@ class BallistaCodec:
                     for part in n.partitions
                 ],
                 schema_from_proto(n.schema),
+                job_id=n.job_id,
+                stage_id=n.stage_id,
+                eager=n.eager,
             )
         if kind == "unresolved_shuffle":
             n = p.unresolved_shuffle

@@ -21,6 +21,8 @@ anything)::
     {"point": "fetch_error", "stage": 1, "partition": 0, "attempt": [0, 1]}
     {"point": "fetch_slow",  "stage": 1, "delay_s": 0.2}
     {"point": "heartbeat_blackout", "executor": "deadbeef*"}
+    {"point": "producer_kill", "stage": 1, "partition": 0,
+     "after_batches": 2, "max_fires": 1}
 
 ``attempt`` matches an int, a list of ints, or "*" (default). ``executor``
 supports a trailing-``*`` prefix match. ``p`` (default 1.0) fires the rule
@@ -41,6 +43,10 @@ Injection points (all default-off, one ``is None`` check when disabled):
 - ``heartbeat_suppressed`` — executor heartbeat/poll paths; a matching
   ``heartbeat_blackout`` silences the executor so the scheduler's expiry
   sweep sees it die.
+- ``on_serve_batch`` — the Flight service's shuffle stream, per served
+  batch; a matching ``producer_kill`` breaks the stream after
+  ``after_batches`` batches already reached the consumer (the
+  producer-dies-mid-stream recovery shape, docs/shuffle.md).
 
 Normal runs must never be poisoned by a stray env var: tests/conftest.py
 strips ``BALLISTA_FAULTS*`` from the environment and asserts the harness
@@ -67,6 +73,7 @@ POINTS = (
     "fetch_error",
     "fetch_slow",
     "heartbeat_blackout",
+    "producer_kill",
 )
 
 
@@ -186,6 +193,42 @@ class FaultInjector:
             if self._fire(idx, r, "fetch_error", key):
                 raise InjectedFetchError(
                     f"injected fetch failure at {key}"
+                )
+
+    def on_serve_batch(
+        self,
+        job_id: str,
+        stage_id: int,
+        partition: int,
+        batch_index: int,
+        path: str = "",
+    ) -> None:
+        """Flight service, per batch SERVED from a shuffle file: a matching
+        ``producer_kill`` rule breaks the stream once ``after_batches``
+        batches already flowed to the consumer — the producer-dies-
+        mid-stream shape (the consumer has real partial data; the rest of
+        that output must be recomputed). Keyed by the PRODUCING (job,
+        stage, output partition); pair with a heartbeat_blackout or
+        ``StandaloneCluster.kill_executor`` to take the whole executor
+        down, not just one stream."""
+        for idx, r in enumerate(self.rules):
+            if r["point"] != "producer_kill":
+                continue
+            if not self._match_scalar(r.get("job"), job_id):
+                continue
+            if not self._match_scalar(r.get("stage"), stage_id):
+                continue
+            if not self._match_scalar(r.get("partition"), partition):
+                continue
+            if batch_index < int(r.get("after_batches", 1)):
+                continue
+            # the serving file path rides in the key so a chaos test can
+            # identify WHICH executor's stream broke (and kill it); rule
+            # matching never looks at it, so determinism is unaffected
+            key = (job_id, stage_id, partition, batch_index, path)
+            if self._fire(idx, r, "producer_kill", key):
+                raise InjectedFault(
+                    f"injected producer kill mid-stream at {key}"
                 )
 
     def heartbeat_suppressed(self, executor_id: str) -> bool:

@@ -1,0 +1,191 @@
+"""Chaos acceptance for EAGER shuffle (ISSUE 6, docs/shuffle.md).
+
+A two-executor cluster runs TPC-H q5 with eager shuffle ON (the default)
+while a map executor dies mid-stream: the producer_kill fault breaks one
+shuffle stream AFTER the consumer already streamed part of that
+executor's output, and the test then kills that same executor outright
+(loops stopped, Flight down, work dir DELETED). Lineage recovery must
+recompute the lost map output and the final result must be BIT-EXACT vs a
+clean fault-free run with identical settings — the guarantee that eager,
+pre-barrier consumption cannot observe a different stream than barriered
+consumption, even across recovery.
+
+Small device batches (ballista.tpu.batch_rows) make shuffle files
+multi-batch at this SF, so "mid-stream" is a real position inside a file,
+not a whole-file boundary.
+
+Runs in a subprocess (cleaned JAX-on-CPU env, like the other distributed
+tests); fault rules are installed programmatically inside it — the
+conftest guard keeps the pytest process itself injection-free.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from tests.conftest import CPU_MESH_ENV
+
+SCRIPT = r"""
+import pathlib
+import threading
+import time
+
+import pandas as pd
+
+from ballista_tpu.client.context import BallistaContext
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.testing import faults
+from ballista_tpu.tpch import gen_all
+
+QDIR = pathlib.Path("benchmarks/queries")
+SF = 0.02
+data = gen_all(scale=SF)
+
+SETTINGS = {
+    "ballista.shuffle.partitions": "2",
+    "ballista.tpu.fetch_backoff_ms": "10",
+    # small device batches -> multi-batch shuffle files, so producer_kill
+    # can break a stream genuinely mid-file
+    "ballista.tpu.batch_rows": "4096",
+    # eager is the default; pin it anyway — this test is ABOUT eager mode
+    "ballista.tpu.eager_shuffle": "true",
+}
+
+
+def make_ctx():
+    cfg = BallistaConfig()
+    for k, v in SETTINGS.items():
+        cfg = cfg.with_setting(k, v)
+    ctx = BallistaContext.standalone(
+        cfg,
+        n_executors=2,
+        executor_timeout_s=2.0,
+        expiry_check_interval_s=0.5,
+    )
+    for name, t in data.items():
+        ctx.register_table(name, t)
+    return ctx
+
+
+def run_q5(ctx):
+    sql = (QDIR / "q5.sql").read_text()
+    return ctx.sql(sql).collect().to_pandas()
+
+
+# ---- clean pass (no faults) ------------------------------------------------
+assert not faults.enabled()
+clean_ctx = make_ctx()
+clean = run_q5(clean_ctx)
+clean_ctx.close()
+assert len(clean) > 0, f"q5 empty at SF={SF}: comparison trivial"
+print("CLEAN-OK", len(clean))
+
+# ---- chaos pass ------------------------------------------------------------
+# ONE stream of ONE map output breaks after >= 1 batch already flowed to a
+# consumer; a slow-fetch rule stretches the shuffle phase so the follow-up
+# executor kill lands mid-query deterministically enough to assert on
+faults.install(
+    [
+        {"point": "producer_kill", "after_batches": 1, "max_fires": 1},
+        {"point": "fetch_slow", "delay_s": 0.03},
+    ],
+    seed=11,
+)
+chaos_ctx = make_ctx()
+cluster = chaos_ctx._standalone_cluster
+sched = cluster.scheduler
+
+result = {}
+errors = []
+
+
+def drive():
+    try:
+        result["df"] = run_q5(chaos_ctx)
+    except Exception as e:  # noqa: BLE001
+        errors.append(repr(e))
+
+
+t = threading.Thread(target=drive)
+t.start()
+
+# wait for the injected mid-stream break, then identify the executor whose
+# file was being served (the path rides in the injection log) and kill it
+inj = faults.active()
+victim_path = None
+deadline = time.time() + 120
+while time.time() < deadline and victim_path is None:
+    for point, key in list(inj.log):
+        if point == "producer_kill":
+            victim_path = key[4]
+            break
+    time.sleep(0.005)
+assert victim_path is not None, "producer_kill never fired"
+victim_idx = next(
+    i for i, h in enumerate(cluster.executors)
+    if victim_path.startswith(h.work_dir)
+)
+job = next(iter(sched.jobs.values()))
+assert job.status == "running", (
+    f"job finished before the kill (status={job.status})"
+)
+killed = cluster.kill_executor(victim_idx, lose_shuffle=True)
+print("KILLED", victim_idx, killed)
+
+t.join(timeout=300)
+assert not t.is_alive(), "q5 wedged after producer kill"
+assert not errors, errors
+
+jobs = list(sched.jobs.values())
+assert all(j.status == "completed" for j in jobs), [
+    (j.job_id, j.status, j.error) for j in jobs
+]
+recovery = sum(j.total_retries + j.total_recomputes for j in jobs)
+assert recovery >= 1, (
+    "producer kill left no trace in retry/recompute counters: "
+    + repr([(j.job_id, j.total_retries, j.total_recomputes) for j in jobs])
+)
+print("RECOVERY-COUNTERS", [
+    (j.job_id, j.total_retries, j.total_recomputes) for j in jobs
+])
+
+# ---- bit-exactness vs the clean run ----------------------------------------
+got = result["df"]
+assert list(got.columns) == list(clean.columns)
+wk = clean.sort_values(list(clean.columns)).reset_index(drop=True)
+gk = got.sort_values(list(got.columns)).reset_index(drop=True)
+pd.testing.assert_frame_equal(gk, wk, check_exact=True)
+chaos_ctx.close()
+faults.install(None)
+print("EAGER-BIT-EXACT-OK")
+print("CHAOS-EAGER-OK")
+"""
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # 2 clusters + SF=0.02 q5 runs + expiry waits — over the
+# tier-1 per-test bar; the eager reader's fast semantics stay tier-1-covered
+# by tests/test_shuffle_pipeline.py
+def test_chaos_eager_producer_kill_mid_stream_bit_exact():
+    env = {k: v for k, v in CPU_MESH_ENV.items() if k != "XLA_FLAGS"}
+    proc = subprocess.run(
+        [sys.executable, "-c", SCRIPT],
+        env=env,
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
+        capture_output=True,
+        text=True,
+        timeout=1500,
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-4000:]}"
+    )
+    for marker in (
+        "CLEAN-OK", "KILLED", "RECOVERY-COUNTERS",
+        "EAGER-BIT-EXACT-OK", "CHAOS-EAGER-OK",
+    ):
+        assert marker in proc.stdout, (
+            f"missing {marker}\nstdout:\n{proc.stdout}\n"
+            f"stderr:\n{proc.stderr[-4000:]}"
+        )

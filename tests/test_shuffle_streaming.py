@@ -27,7 +27,11 @@ def hwm_kb():
     for line in open("/proc/self/status"):
         if line.startswith("VmHWM"):
             return int(line.split()[1])
-    raise RuntimeError("no VmHWM")
+    # kernels without VmHWM (some container hosts): ru_maxrss is the same
+    # per-process monotonic high-water mark, in KB on Linux
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
 
 # ~256MB shuffle partition in 2MB record batches
 tmp = tempfile.mkdtemp()
@@ -61,7 +65,9 @@ remote = PartitionLocation(
 import dataclasses
 import ballista_tpu.client.flight as fl
 orig = fl.make_ticket
-fl.make_ticket = lambda l: orig(dataclasses.replace(l, path=path))
+fl.make_ticket = lambda l, compression="": orig(
+    dataclasses.replace(l, path=path), compression
+)
 
 schema2 = Schema([Field("k", DataType.INT64), Field("v", DataType.FLOAT64)])
 plan = ShuffleReaderExec([[remote]], schema2)
