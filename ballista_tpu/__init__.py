@@ -40,13 +40,36 @@ _jax.config.update("jax_enable_x64", True)
 # (operator, batch capacity); over a tunneled TPU each compile costs tens of
 # seconds, so caching across processes is the difference between minutes and
 # milliseconds on re-runs of the same query shapes.
+#
+# BALLISTA_TPU_JAX_CACHE=off disables the cache MACHINERY, not just the
+# directory: leaving jax's default cache config half-armed still pays the
+# per-compile eligibility walk (and can write to a stale dir a later
+# config.update picks). With the cache on, the min-compile-time floor is 0:
+# the engine's vocabulary is dominated by sub-0.5s kernels (argsort/gather
+# per capacity bucket) whose FIRST cold run is exactly what the cache
+# exists to kill — jax's 0.5s default would never persist them.
 _cache_dir = _os.environ.get(
     "BALLISTA_TPU_JAX_CACHE",
     _os.path.join(_os.path.expanduser("~"), ".cache", "ballista_tpu_jax"),
 )
 if _cache_dir != "off":
     _jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+else:
+    _jax.config.update("jax_enable_compilation_cache", False)
+
+# The resolved cache decision — the first thing to check when cold-start
+# regresses (a wrong/unwritable dir silently degrades every cold run to
+# full XLA compiles). Logged here for embedders whose logging is already
+# configured; the daemon entrypoints re-log it AFTER their basicConfig
+# (this import-time record predates any handler in those processes).
+jax_cache_dir: str | None = _cache_dir if _cache_dir != "off" else None
+
+import logging as _logging
+
+_logging.getLogger(__name__).info(
+    "jax persistent compilation cache: %s", jax_cache_dir or "disabled"
+)
 
 __version__ = "0.1.0"
 

@@ -12,6 +12,12 @@ line — the single command CI (and a developer pre-push) needs:
 - **jaxlint** — JAX/TPU hazard lint over ``ops/`` + ``exec/``.
 - **racelint** — lock-discipline + state-machine lint over the
   concurrent control plane (suppression budget enforced here too).
+- **compile-vocab** — the closed compiled-kernel vocabulary gate
+  (compilecache/registry.py): every jit site in the source report must be
+  registered, and every operator class reachable from TPC-H q1-q22
+  logical→physical→stage lowering must declare its compile surface — a
+  silently-grown recompile vocabulary is a cold-start regression
+  (docs/compile_cache.md).
 
 Flags: ``--dot`` prints the racelint lock-order graph (Graphviz) and
 exits; ``--tables`` prints the canonical status state machines and
@@ -25,7 +31,9 @@ from __future__ import annotations
 import argparse
 import sys
 
-ANALYZERS = ("planlint", "serde-audit", "jaxlint", "racelint")
+ANALYZERS = (
+    "planlint", "serde-audit", "jaxlint", "racelint", "compile-vocab"
+)
 
 
 def run_planlint(queries=None) -> tuple[bool, str]:
@@ -106,6 +114,56 @@ def run_racelint() -> tuple[bool, str]:
     )
 
 
+def run_compile_vocab(queries=None) -> tuple[bool, str]:
+    """Closed-vocabulary gate: the source-derived jit-site report must
+    match compilecache.registry.VOCABULARY, and every operator class in
+    the TPC-H physical/stage plans must be mapped in OPERATOR_KERNELS."""
+    import pathlib
+
+    from ballista_tpu.compilecache import registry
+    from ballista_tpu.distributed_plan import DistributedPlanner
+    from ballista_tpu.exec.context import TpuContext
+    from ballista_tpu.exec.planner import PhysicalPlanner
+    from ballista_tpu.plan.optimizer import optimize
+    from ballista_tpu.tpch import gen_all
+
+    problems = registry.check_vocabulary()
+
+    qdir = (
+        pathlib.Path(__file__).resolve().parents[2]
+        / "benchmarks" / "queries"
+    )
+    ctx = TpuContext()
+    for name, tab in gen_all(scale=0.001).items():
+        ctx.register_table(name, tab)
+    qs = list(queries) if queries else list(range(1, 23))
+    kernels: set[str] = set()
+    for i in qs:
+        sql = (qdir / f"q{i}.sql").read_text()
+        optimized = optimize(ctx.sql_to_logical(sql))
+        phys = ctx.create_physical_plan(optimized, sql=sql)
+        problems += [
+            f"q{i} (physical): {p}" for p in registry.check_plan(phys)
+        ]
+        kernels |= registry.plan_kernels(phys)
+        dist = PhysicalPlanner(
+            ctx, 2, config=ctx.config, distributed=True
+        ).plan(optimized)
+        stages = DistributedPlanner().plan_query_stages(f"job-q{i}", dist)
+        for st in stages:
+            problems += [
+                f"q{i} (stage {st.stage_id}): {p}"
+                for p in registry.check_plan(st.plan)
+            ]
+            kernels |= registry.plan_kernels(st.plan)
+    if problems:
+        return False, "\n".join(problems)
+    return True, (
+        f"{len(registry.VOCABULARY)} kernels registered; {len(qs)} TPC-H "
+        f"queries lower onto {len(kernels)} of them, all in vocabulary"
+    )
+
+
 def run_all(
     skip=(), only=(), queries=None, out=print
 ) -> int:
@@ -115,6 +173,7 @@ def run_all(
         "serde-audit": run_serde_audit,
         "jaxlint": run_jaxlint,
         "racelint": run_racelint,
+        "compile-vocab": lambda: run_compile_vocab(queries),
     }
     failed = []
     for name in ANALYZERS:

@@ -1,4 +1,10 @@
-from ballista_tpu.columnar.batch import DeviceBatch, round_capacity
+from ballista_tpu.columnar.batch import (
+    CapacityLadder,
+    DeviceBatch,
+    capacity_ladder,
+    round_capacity,
+    set_capacity_buckets,
+)
 from ballista_tpu.columnar.arrow_interop import (
     batch_from_arrow,
     batch_to_arrow,
@@ -6,8 +12,11 @@ from ballista_tpu.columnar.arrow_interop import (
 )
 
 __all__ = [
+    "CapacityLadder",
     "DeviceBatch",
+    "capacity_ladder",
     "round_capacity",
+    "set_capacity_buckets",
     "batch_from_arrow",
     "batch_to_arrow",
     "table_from_arrow",

@@ -30,15 +30,134 @@ from ballista_tpu.datatypes import DataType, Field, Schema
 from ballista_tpu.errors import InternalError, SchemaError
 
 # Minimum batch capacity. 2048 = 8 sublanes * 256 — comfortably tileable; we
-# round capacities to powers of two above this so the jit cache stays small.
+# round capacities up a geometric bucket ladder above this so the jit cache
+# stays small (every distinct capacity is a distinct compiled-program
+# signature — docs/compile_cache.md).
 MIN_CAPACITY = 2048
+
+
+class CapacityLadder:
+    """The process-wide capacity-bucket policy.
+
+    Every static row capacity in the engine (scan batches, join build
+    tables, aggregate states, expansion outputs, shrink targets) rounds up
+    through ONE ladder so unrelated queries land on the same compiled
+    programs. The ladder is geometric — ``min_cap * ratio**k`` — or an
+    explicit sorted bucket list extended geometrically past its top; the
+    default (min 2048, ratio 2) is the engine's historical power-of-two
+    rounding. Configure via ``ballista.tpu.capacity_buckets``
+    ("<min>:<ratio>" or "b0,b1,b2,..."): a coarser ratio trades padding
+    (bounded by the ratio) for a smaller compile vocabulary.
+    """
+
+    def __init__(self, min_cap: int = MIN_CAPACITY, ratio: int = 2,
+                 explicit: tuple[int, ...] | None = None):
+        if explicit:
+            explicit = tuple(sorted(set(int(b) for b in explicit)))
+            if explicit[0] < 8:
+                raise ValueError(f"capacity bucket too small: {explicit[0]}")
+            min_cap = explicit[0]
+        if min_cap < 8:
+            raise ValueError(f"min capacity too small: {min_cap}")
+        if ratio < 2:
+            raise ValueError(f"bucket ratio must be >= 2: {ratio}")
+        self.min_cap = int(min_cap)
+        self.ratio = int(ratio)
+        self.explicit = explicit
+
+    @classmethod
+    def parse(cls, spec: str) -> "CapacityLadder":
+        spec = (spec or "").strip()
+        if not spec:
+            return cls()
+        if "," in spec:
+            lad = cls(explicit=tuple(
+                int(s) for s in spec.split(",") if s.strip()
+            ))
+        elif ":" in spec:
+            mn, _, r = spec.partition(":")
+            lad = cls(min_cap=int(mn), ratio=int(r))
+        else:
+            lad = cls(min_cap=int(spec))
+        # configured ladders keep the engine-wide tileable floor the old
+        # pow2 rounding enforced unconditionally (the raw constructor
+        # stays relaxed for targeted tests)
+        if lad.min_cap < MIN_CAPACITY:
+            raise ValueError(
+                f"capacity bucket below the {MIN_CAPACITY} tileable "
+                f"minimum: {lad.min_cap}"
+            )
+        return lad
+
+    def spec(self) -> str:
+        if self.explicit:
+            return ",".join(str(b) for b in self.explicit)
+        return f"{self.min_cap}:{self.ratio}"
+
+    def round(self, n: int) -> int:
+        """Smallest ladder bucket >= n (geometric past any explicit top)."""
+        if self.explicit:
+            for b in self.explicit:
+                if n <= b:
+                    return b
+            cap = self.explicit[-1]
+        else:
+            cap = self.min_cap
+        while cap < n:
+            cap *= self.ratio
+        return cap
+
+    def buckets_upto(self, n: int) -> tuple[int, ...]:
+        """Every ladder bucket <= round(n) — the prewarm enumeration."""
+        top = self.round(max(n, self.min_cap))
+        out = list(b for b in (self.explicit or ()) if b <= top)
+        cap = out[-1] if out else self.min_cap
+        if not out:
+            out.append(cap)
+        while cap < top:
+            cap *= self.ratio
+            out.append(cap)
+        return tuple(out)
+
+
+_LADDER = CapacityLadder()
+_LADDER_INSTALLED = False  # flips-after-install are logged (see below)
+
+
+def set_capacity_buckets(spec: str) -> "CapacityLadder":
+    """Install the process-wide bucket ladder (``TpuContext`` and the
+    executor task entry apply ``ballista.tpu.capacity_buckets`` here).
+    Process-global by design: capacities are compiled-program signatures,
+    and two ladders in one process would double the vocabulary the whole
+    subsystem exists to shrink. Mixed-capacity batches in flight across a
+    change remain valid (capacity is carried per batch, never re-derived).
+    """
+    global _LADDER, _LADDER_INSTALLED
+    ladder = CapacityLadder.parse(spec)
+    if ladder.spec() != _LADDER.spec():
+        if _LADDER_INSTALLED:
+            # a mid-process flip is legal but costly: an executor serving
+            # sessions with different ladders compiles BOTH vocabularies
+            # and re-learns adaptive capacities across each swap
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "capacity ladder changed %s -> %s; mixed-ladder sessions "
+                "on one executor grow the compile vocabulary",
+                _LADDER.spec(), ladder.spec(),
+            )
+        _LADDER = ladder
+        _LADDER_INSTALLED = True
+    return _LADDER
+
+
+def capacity_ladder() -> CapacityLadder:
+    return _LADDER
 
 
 def round_capacity(n: int) -> int:
     """Round a row count up to the bucketed static capacity."""
-    if n <= MIN_CAPACITY:
-        return MIN_CAPACITY
-    return 1 << (n - 1).bit_length()
+    return _LADDER.round(n)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,268 @@
+"""Persisted plan-shape hints: the learned-capacity half of cold-start.
+
+The XLA persistent cache and the shared trace cache kill the *compile*
+half of a fresh process's first query, but profiling the remaining cold
+gap (docs/compile_cache.md) showed the larger half is *learning*: until
+the adaptive machinery has observed the data, a cold process probes join
+build strategies (collecting and sorting a fact side purely for the
+decision), runs merge folds at full un-sliced state capacity, pays the
+aggregate overflow→grow retry round, and re-measures every shrink site —
+all process-local state in ``TaskContext.plan_cache`` and the
+``agg_capacity`` hint, re-derived from scratch on every restart.
+
+This module persists that state next to the XLA cache. Safety is
+inherited, not added: every plan-cache family is either deferred-
+validated speculation (a stale entry fires its flag at the task boundary
+→ ``SpeculationMiss`` → invalidate + re-run, exec/base.py) or learn-only
+input, so a hint file from last week degrades to one extra re-run in the
+worst case and can never change results. Keys/values are serialized with
+``repr`` and parsed with ``ast.literal_eval`` — an entry that fails the
+round-trip (device arrays must never reach a clean task boundary, but be
+defensive) is silently dropped, as is the ``__build_cache_bytes__`` HBM
+tally, which meters in-process build tables that die with the process.
+
+Layout: one JSON file, ``plan_hints.json``, in the resolved hint dir —
+``BALLISTA_TPU_HINT_CACHE`` when set (``off`` disables), else the XLA
+cache dir (``BALLISTA_TPU_JAX_CACHE``), so ``off`` there keeps the whole
+persistence surface inert (satellite 1). Writes are atomic
+(tmp + ``os.replace``) and debounced by content fingerprint; concurrent
+executors sharing a dir are last-writer-wins, which is safe for the same
+reason staleness is.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import logging
+import os
+import tempfile
+import threading
+
+from ballista_tpu.compilecache import metrics
+
+log = logging.getLogger(__name__)
+
+HINT_FILE = "plan_hints.json"
+_VERSION = 1
+# matches run_with_capacity_retry's in-memory bound; a fuller file would
+# just be cleared on load anyway
+_MAX_ENTRIES = 4096
+# process-local tallies that meter in-process objects — never persisted
+_EPHEMERAL_KEYS = frozenset({"__build_cache_bytes__"})
+
+
+def store_path() -> str | None:
+    """Resolved hint-file path, or None when persistence is off."""
+    spec = os.environ.get("BALLISTA_TPU_HINT_CACHE", "")
+    if not spec:
+        spec = os.environ.get(
+            "BALLISTA_TPU_JAX_CACHE",
+            os.path.join(
+                os.path.expanduser("~"), ".cache", "ballista_tpu_jax"
+            ),
+        )
+    if spec == "off":
+        return None
+    return os.path.join(spec, HINT_FILE)
+
+
+def _canon(x):
+    """Recursively replace numpy scalars with python natives (their repr
+    — ``np.True_``, ``np.int64(8)`` — does not literal_eval) so learned
+    join flags and capacities survive encoding regardless of which layer
+    produced them."""
+    if isinstance(x, tuple):
+        return tuple(_canon(v) for v in x)
+    item = getattr(x, "item", None)
+    if item is not None and getattr(x, "ndim", None) == 0:
+        return x.item()
+    return x
+
+
+def _encode(x) -> str | None:
+    """repr of the canonicalized value when it literal_evals back to an
+    equal value, else None."""
+    s = repr(_canon(x))
+    try:
+        return s if ast.literal_eval(s) == x else None
+    except (ValueError, SyntaxError, MemoryError, RecursionError):
+        return None
+
+
+class HintStore:
+    """One owner's (TpuContext / Executor) handle on the hint file.
+
+    ``load_once`` merges persisted entries under the owner's existing
+    state (in-memory learning always wins); ``save_if_changed`` writes
+    the owner's current state back when its fingerprint moved. A write
+    failure (read-only cache dir) disables further writes for this store
+    rather than warning per query.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._loaded = False
+        self._last_fp: int | None = None
+        self._write_failed = False
+
+    def load_once(self, hint: dict, plan_cache: dict) -> int:
+        """Merge the hint file into ``hint``/``plan_cache`` (first call
+        only; later calls are free no-ops). Returns entries merged."""
+        with self._lock:
+            if self._loaded:
+                return 0
+            self._loaded = True
+            path = store_path()
+            if path is None:
+                return 0
+            try:
+                with open(path, encoding="utf-8") as f:
+                    doc = json.load(f)
+            except FileNotFoundError:
+                return 0
+            except (OSError, ValueError) as e:
+                log.warning("plan-hint cache unreadable (%s): %s", path, e)
+                return 0
+            if not isinstance(doc, dict) or doc.get("version") != _VERSION:
+                return 0
+            n = 0
+            cap = doc.get("agg_capacity")
+            if isinstance(cap, int) and cap > hint.get("agg_capacity", 0):
+                hint["agg_capacity"] = cap
+                n += 1
+            entries = doc.get("entries")
+            if isinstance(entries, dict):
+                for ks, vs in entries.items():
+                    try:
+                        k = ast.literal_eval(ks)
+                        v = ast.literal_eval(vs)
+                    except (ValueError, SyntaxError, MemoryError,
+                            RecursionError):
+                        continue
+                    if k not in plan_cache:
+                        plan_cache[k] = v
+                        n += 1
+            if n:
+                metrics.add("hints_loaded", n)
+                log.info(
+                    "plan-hint cache: %d entries from %s", n, path
+                )
+            # fingerprint AFTER the merge: a workload that learns nothing
+            # new never rewrites the file
+            self._last_fp = _fingerprint(hint, plan_cache)
+            return n
+
+    def save_if_changed(self, hint: dict, plan_cache: dict) -> bool:
+        """Persist the current state when it differs from the last
+        loaded/saved fingerprint. Returns True on a write."""
+        with self._lock:
+            if self._write_failed:
+                return False
+            path = store_path()
+            if path is None:
+                return False
+            fp = _fingerprint(hint, plan_cache)
+            if fp == self._last_fp:
+                return False
+            doc = _document(hint, plan_cache)
+            # merge UNDER the on-disk state rather than replacing it: the
+            # owner's plan cache is cleared by table (re)registration, so
+            # a wholesale write after that would destroy every other
+            # query's / process's persisted learning; current in-memory
+            # entries win per key, agg_capacity takes the max
+            try:
+                with open(path, encoding="utf-8") as f:
+                    prev = json.load(f)
+            except (OSError, ValueError):
+                prev = None
+            if (
+                isinstance(prev, dict)
+                and prev.get("version") == _VERSION
+            ):
+                prev_cap = prev.get("agg_capacity")
+                if isinstance(prev_cap, int) and prev_cap > (
+                    doc["agg_capacity"] or 0
+                ):
+                    doc["agg_capacity"] = prev_cap
+                prev_entries = prev.get("entries")
+                if isinstance(prev_entries, dict):
+                    merged = dict(prev_entries)
+                    merged.update(doc["entries"])
+                    if len(merged) > _MAX_ENTRIES:
+                        # drop oldest on-disk-only entries first; the
+                        # owner's own (newest) entries always survive
+                        overflow = len(merged) - _MAX_ENTRIES
+                        for k in list(prev_entries):
+                            if overflow == 0:
+                                break
+                            if k not in doc["entries"]:
+                                del merged[k]
+                                overflow -= 1
+                    doc["entries"] = merged
+            try:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                fd, tmp = tempfile.mkstemp(
+                    dir=os.path.dirname(path), suffix=".tmp"
+                )
+                try:
+                    with os.fdopen(fd, "w", encoding="utf-8") as f:
+                        json.dump(doc, f)
+                    os.replace(tmp, path)
+                except BaseException:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+                    raise
+            except OSError as e:
+                log.warning(
+                    "plan-hint cache not writable (%s): %s — hint "
+                    "persistence disabled for this process", path, e,
+                )
+                self._write_failed = True
+                return False
+            self._last_fp = fp
+            metrics.add("hints_saved")
+            return True
+
+
+def _persistable(plan_cache: dict):
+    """Yield (repr-key, repr-value) for every entry that survives the
+    literal_eval round trip, newest-biased to _MAX_ENTRIES
+    (``agg_capacity`` is a separate top-level document field)."""
+    items = list(plan_cache.items())
+    if len(items) > _MAX_ENTRIES:
+        items = items[-_MAX_ENTRIES:]
+    for k, v in items:
+        if k in _EPHEMERAL_KEYS:
+            continue
+        ks, vs = _encode(k), _encode(v)
+        if ks is not None and vs is not None:
+            yield ks, vs
+
+
+def _document(hint: dict, plan_cache: dict) -> dict:
+    cap = hint.get("agg_capacity")
+    return {
+        "version": _VERSION,
+        "agg_capacity": cap if isinstance(cap, int) else None,
+        "entries": dict(_persistable(plan_cache)),
+    }
+
+
+def _fingerprint(hint: dict, plan_cache: dict) -> int:
+    """Change-detection only — repr without the literal_eval validation
+    _persistable does: this runs per collect/task on the query hot path,
+    and parsing thousands of entries to decide "nothing changed" would
+    dwarf the write it debounces. Entries repr-unstable enough to fool
+    this just cause one redundant (still-correct) merge-write."""
+    items = []
+    # snapshot first: the executor's task threads mutate this dict
+    # concurrently with a finishing task's save (repr() between loop
+    # steps can yield the GIL mid-iteration)
+    for k, v in list(plan_cache.items()):
+        if k in _EPHEMERAL_KEYS:
+            continue
+        items.append((repr(_canon(k)), repr(_canon(v))))
+    return hash((hint.get("agg_capacity"), tuple(sorted(items))))

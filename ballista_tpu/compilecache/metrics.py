@@ -1,0 +1,122 @@
+"""Compile-latency observability: process-wide counters over jax's
+monitoring events.
+
+Cold-start work is invisible in operator metrics — tracing and XLA
+compilation happen inside jit dispatch, not inside any ExecutionPlan — so
+this module taps ``jax.monitoring`` (the same event stream jax's own
+telemetry uses) and keeps process-global counters:
+
+- ``traces`` / ``trace_seconds`` — jaxpr traces (every distinct
+  (kernel, shape, dtype, static-arg) signature traces once per process;
+  the count is the live measure of the compiled-program vocabulary).
+- ``backend_compiles`` / ``compile_seconds`` — XLA backend compile
+  REQUESTS and the wall time spent inside them (persistent-cache hits
+  still pass through here, cheaply).
+- ``persistent_cache_hits`` / ``persistent_cache_misses`` — the on-disk
+  XLA cache (BALLISTA_TPU_JAX_CACHE): a miss is a real XLA compile.
+- ``cache_retrieval_seconds`` — time spent deserializing cached
+  executables (the cost floor of a cache-hit cold start).
+- ``jit_cache_hits`` / ``jit_cache_misses`` — the shared jitted-callable
+  cache (compilecache.tracecache), recorded by that module.
+- ``prewarmed_signatures`` / ``prewarm_seconds`` — AOT prewarm progress
+  (compilecache.prewarm).
+
+Counters surface per executor through the heartbeat -> scheduler REST
+path (docs/compile_cache.md) and per query through bench.py's tracked
+``n_signatures`` / ``compile_seconds`` fields.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_LOCK = threading.Lock()
+_COUNTERS: dict[str, float] = {}
+_INSTALLED = False
+
+# jax monitoring event -> (counter incremented per event, duration-sum
+# counter or None). Count events exist for both listener kinds; duration
+# events arrive only on the duration listener.
+_EVENT_COUNTERS = {
+    "/jax/core/compile/jaxpr_trace_duration": ("traces", "trace_seconds"),
+    "/jax/core/compile/backend_compile_duration": (
+        "backend_compiles", "compile_seconds",
+    ),
+    "/jax/compilation_cache/cache_hits": ("persistent_cache_hits", None),
+    "/jax/compilation_cache/cache_misses": ("persistent_cache_misses", None),
+    "/jax/compilation_cache/cache_retrieval_time_sec": (
+        None, "cache_retrieval_seconds",
+    ),
+}
+
+
+def add(name: str, value: float = 1) -> None:
+    """Record a counter increment (used by tracecache/prewarm too)."""
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + value
+
+
+def _on_event(event: str, **kw) -> None:
+    counter, _ = _EVENT_COUNTERS.get(event, (None, None))
+    if counter is not None:
+        add(counter)
+
+
+def _on_duration(event: str, duration: float, **kw) -> None:
+    counter, seconds = _EVENT_COUNTERS.get(event, (None, None))
+    if counter is not None:
+        add(counter)
+    if seconds is not None:
+        add(seconds, duration)
+
+
+def install() -> None:
+    """Register the jax.monitoring listeners (idempotent; listeners are
+    append-only in jax, so double-registration would double-count)."""
+    global _INSTALLED
+    with _LOCK:
+        if _INSTALLED:
+            return
+        import jax.monitoring
+
+        # register under the lock so a concurrent caller cannot observe
+        # _INSTALLED and proceed before the listeners actually exist.
+        # count-only events fire the plain listener; duration events fire
+        # the duration listener (NOT both) — no double-counting
+        jax.monitoring.register_event_listener(_on_event)
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        _INSTALLED = True
+
+
+def snapshot() -> dict[str, float]:
+    """Current counters (rounded; installs listeners on first use so a
+    metrics reader never sees a silently-uninstrumented process)."""
+    install()
+    with _LOCK:
+        return {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in sorted(_COUNTERS.items())
+        }
+
+
+class delta:
+    """Context manager capturing the counter delta across a block::
+
+        with metrics.delta() as d:
+            run_query()
+        d.value["traces"]  # signatures traced by run_query
+    """
+
+    def __enter__(self) -> "delta":
+        self._before = snapshot()
+        self.value: dict[str, float] = {}
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        after = snapshot()
+        self.value = {
+            k: round(v - self._before.get(k, 0), 4)
+            for k, v in after.items()
+            if v != self._before.get(k, 0)
+        }
+        return False

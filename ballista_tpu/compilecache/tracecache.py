@@ -1,0 +1,83 @@
+"""Process-wide jitted-callable cache keyed by canonical signature.
+
+The distributed executor decodes a FRESH plan-instance tree for every
+task, so instance-held jits (``self._fn = jax.jit(run)`` in
+FilterExec/ProjectionExec, the join expansion programs, the aggregate
+scalar-state program) used to retrace identical stage plans on every
+attempt and every repeated query — the persistent XLA cache absorbed the
+backend compile, but the Python trace + lowering (hundreds of ms per
+program) re-ran each time. Operators now build their jitted callables
+through :func:`shared_callable`, keyed by the canonical signature of
+everything the traced closure reads from the plan (expression trees via
+``Expr._key()``, schemas, static capacities, join kinds): two plan
+instances with the same signature get the SAME jit wrapper, and jax's
+dispatch cache keys the rest (shapes, dtypes, pytree aux such as
+dictionaries) per call, so sharing a wrapper can never reuse a wrong
+program — it only deduplicates traces.
+
+Bounded LRU: a long-lived executor serves many jobs; evicting a wrapper
+costs at most one retrace (persistent cache still covers the XLA side).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable
+
+from ballista_tpu.compilecache import metrics
+
+_LOCK = threading.Lock()
+_CACHE: OrderedDict = OrderedDict()
+_MAX_ENTRIES = 1024
+
+
+def shared_callable(key: tuple, build: Callable[[], Callable]) -> Callable:
+    """The cached callable for ``key``, building (and jitting) via
+    ``build()`` on miss. ``key`` must capture every plan-derived value the
+    built closure bakes in; runtime-arg structure is jax's job."""
+    with _LOCK:
+        fn = _CACHE.get(key)
+        if fn is not None:
+            _CACHE.move_to_end(key)
+            metrics.add("jit_cache_hits")
+            return fn
+    # build OUTSIDE the lock: builders may import/trace-prep; a slow build
+    # must not stall every other operator's cache lookup. A same-key race
+    # just builds twice and keeps the first-stored wrapper.
+    fn = build()
+    with _LOCK:
+        stored = _CACHE.get(key)
+        if stored is not None:
+            metrics.add("jit_cache_hits")
+            return stored
+        metrics.add("jit_cache_misses")
+        _CACHE[key] = fn
+        while len(_CACHE) > _MAX_ENTRIES:
+            _CACHE.popitem(last=False)
+    return fn
+
+
+def expr_key(e) -> tuple | None:
+    """Canonical hashable key for a logical expression (or None).
+    ``Expr.__eq__`` is builder sugar, so keys go through the structural
+    ``_key()`` the optimizer uses."""
+    if e is None:
+        return None
+    return (type(e).__name__, e._key())
+
+
+def schema_key(schema) -> tuple:
+    """Canonical hashable key for a Schema (name/dtype/nullability)."""
+    return tuple((f.name, f.dtype.value, f.nullable) for f in schema)
+
+
+def cache_len() -> int:
+    with _LOCK:
+        return len(_CACHE)
+
+
+def clear() -> None:
+    """Test hook: drop every shared wrapper (counters are unaffected)."""
+    with _LOCK:
+        _CACHE.clear()

@@ -62,8 +62,28 @@ BALLISTA_SHUFFLE_LOCAL_FASTPATH = (
 BALLISTA_EAGER_SHUFFLE = "ballista.tpu.eager_shuffle"  # pre-barrier consumption
 BALLISTA_EAGER_POLL_MS = "ballista.tpu.eager_poll_ms"  # location poll cadence
 BALLISTA_EAGER_WAIT_S = "ballista.tpu.eager_wait_s"  # unpublished-location deadline
+BALLISTA_CAPACITY_BUCKETS = (
+    "ballista.tpu.capacity_buckets"  # static-shape bucket ladder
+)
+BALLISTA_PREWARM = "ballista.tpu.prewarm"  # AOT kernel prewarm: off|on|background
 
 SHUFFLE_COMPRESSION_CODECS = ("none", "lz4", "zstd")
+
+PREWARM_MODES = ("off", "on", "background")
+
+
+def _parse_prewarm(s: str) -> str:
+    v = s.lower()
+    if v not in PREWARM_MODES:
+        raise ValueError(f"not a prewarm mode (off|on|background): {s!r}")
+    return v
+
+
+def _parse_capacity_buckets(s: str) -> str:
+    from ballista_tpu.columnar.batch import CapacityLadder
+
+    CapacityLadder.parse(s)  # raises on malformed specs
+    return s
 
 
 def _parse_shuffle_compression(s: str) -> str:
@@ -367,6 +387,33 @@ def _entries() -> dict[str, ConfigEntry]:
             int,
         ),
         ConfigEntry(
+            BALLISTA_CAPACITY_BUCKETS,
+            "Static-shape capacity-bucket ladder (docs/compile_cache.md): "
+            "every padded row capacity rounds UP through this ladder so "
+            "unrelated queries share compiled programs. '<min>:<ratio>' "
+            "is geometric (default 2048:2, the historical power-of-two "
+            "rounding); an explicit 'b0,b1,...' list is extended "
+            "geometrically past its top. Coarser ladders shrink the "
+            "compile vocabulary (fewer distinct signatures to trace, "
+            "compile, and prewarm) at the cost of up to ratio-1 x padding "
+            "on intermediate results.",
+            "2048:2",
+            _parse_capacity_buckets,
+        ),
+        ConfigEntry(
+            BALLISTA_PREWARM,
+            "AOT-compile the closed kernel vocabulary (ops/: sort, "
+            "gather, compact primitives per capacity bucket and dtype — "
+            "ballista_tpu/compilecache/registry.py) at context/executor "
+            "start, populating the jit and persistent XLA caches before "
+            "the first query: 'on' blocks startup until warm, "
+            "'background' compiles on a small thread pool joined at "
+            "shutdown, 'off' (default) pays compiles lazily on the first "
+            "query that needs each kernel.",
+            "off",
+            _parse_prewarm,
+        ),
+        ConfigEntry(
             BALLISTA_EAGER_WAIT_S,
             "Deadline (seconds) an eager reader waits for a "
             "not-yet-published upstream location before failing the task "
@@ -520,6 +567,12 @@ class BallistaConfig:
 
     def eager_wait_s(self) -> float:
         return max(0.0, self._get(BALLISTA_EAGER_WAIT_S))
+
+    def capacity_buckets(self) -> str:
+        return self._get(BALLISTA_CAPACITY_BUCKETS)
+
+    def prewarm(self) -> str:
+        return self._get(BALLISTA_PREWARM)
 
     def __eq__(self, other) -> bool:
         return (

@@ -484,6 +484,81 @@ def _stat_final(outs_at, idxs, kind):
     return vals, nl
 
 
+def _scalar_state_program(slots, schema: Schema, b: DeviceBatch) -> DeviceBatch:
+    """Per-batch scalar (no GROUP BY) partial state. Module-level on
+    purpose: the jitted wrapper lives in the process-wide trace cache
+    (compilecache/tracecache.py), so it must capture only these small
+    derived values — never the HashAggregateExec instance, whose input
+    chain reaches scan tables and uploaded device batches."""
+    val_cols, val_nulls = [], []
+    for s in slots:
+        if s.src is None:
+            val_cols.append(jnp.ones(b.capacity, dtype=jnp.int64))
+            val_nulls.append(None)
+        else:
+            val_cols.append(b.columns[s.src])
+            val_nulls.append(b.nulls[s.src])
+    outs, nulls = scalar_aggregate(
+        b.valid, val_cols, val_nulls, [s.op for s in slots]
+    )
+    cols = []
+    for v, f in zip(outs, schema):
+        arr = jnp.zeros(2048, dtype=f.dtype.to_np()).at[0].set(
+            v.astype(f.dtype.to_np())
+        )
+        cols.append(arr)
+    valid = jnp.zeros(2048, dtype=bool).at[0].set(True)
+    null_masks = []
+    for nl in nulls:
+        if nl is None:
+            null_masks.append(None)
+        else:
+            null_masks.append(jnp.zeros(2048, dtype=bool).at[0].set(nl))
+    return DeviceBatch(
+        schema=schema,
+        columns=tuple(cols),
+        valid=valid,
+        nulls=tuple(null_masks),
+        dictionaries={},
+    )
+
+
+def _finalize_scalar_program(finals, schema: Schema, outs, nulls) -> DeviceBatch:
+    """Scalar-aggregate finalization (AVG division, statistical finals,
+    pass-through) to a 1-valid-row batch. Module-level for the same
+    trace-cache capture discipline as _scalar_state_program."""
+    cap = 2048
+    cols, null_masks = [], []
+    for name, dtype, idxs, kind in finals:
+        if kind == "avg":
+            s, c = outs[idxs[0]], outs[idxs[1]]
+            v = s.astype(jnp.float64) / jnp.maximum(c, 1).astype(jnp.float64)
+            nl = c == 0
+        elif kind in (
+            "var_samp", "var_pop", "stddev_samp", "stddev_pop", "corr"
+        ):
+            v, nl = _stat_final(lambda i: outs[i], idxs, kind)
+        else:
+            v = outs[idxs[0]]
+            nl = nulls[idxs[0]]
+        arr = jnp.zeros(cap, dtype=dtype.to_np()).at[0].set(
+            v.astype(dtype.to_np())
+        )
+        cols.append(arr)
+        if nl is None:
+            null_masks.append(None)
+        else:
+            null_masks.append(jnp.zeros(cap, dtype=bool).at[0].set(nl))
+    valid = jnp.zeros(cap, dtype=bool).at[0].set(True)
+    return DeviceBatch(
+        schema=schema,
+        columns=tuple(cols),
+        valid=valid,
+        nulls=tuple(null_masks),
+        dictionaries={},
+    )
+
+
 def finalize_state(
     state: DeviceBatch, spec: AggSpec, out_schema: Schema
 ) -> DeviceBatch:
@@ -1106,48 +1181,40 @@ class HashAggregateExec(ExecutionPlan):
             out.keys_unique = True
             yield out
 
+    def _spec_cache_key(self) -> tuple:
+        """Canonical signature of the scalar-aggregate programs: the spec
+        decomposition + output schema are everything their closures read
+        from the instance, so executor-decoded fresh instances share one
+        jit wrapper per signature (compilecache/tracecache.py)."""
+        from ballista_tpu.compilecache import expr_key, schema_key
+
+        s = self.spec
+        return (
+            s.group_names,
+            s.slots,
+            s.finals,
+            tuple(expr_key(e) for e in s.arg_exprs),
+            schema_key(self._schema),
+        )
+
     def _scalar_state_fn(self):
         """Jitted per-batch scalar state (one program instead of eager
         per-op dispatches — on a tunnelled chip each eager op is a
         round trip)."""
         if getattr(self, "_scalar_jit", None) is None:
-            self._scalar_jit = jax.jit(self._scalar_state)
-        return self._scalar_jit
+            from ballista_tpu.compilecache import shared_callable
 
-    def _scalar_state(self, b: DeviceBatch) -> DeviceBatch:
-        val_cols, val_nulls = [], []
-        for s in self.spec.slots:
-            if s.src is None:
-                val_cols.append(jnp.ones(b.capacity, dtype=jnp.int64))
-                val_nulls.append(None)
-            else:
-                val_cols.append(b.columns[s.src])
-                val_nulls.append(b.nulls[s.src])
-        outs, nulls = scalar_aggregate(
-            b.valid, val_cols, val_nulls, [s.op for s in self.spec.slots]
-        )
-        import numpy as np
-
-        cols = []
-        for v, f in zip(outs, self._schema):
-            arr = jnp.zeros(2048, dtype=f.dtype.to_np()).at[0].set(
-                v.astype(f.dtype.to_np())
+            # capture only the small derived values the program reads —
+            # a bound method would pin this whole plan subtree (scan
+            # tables, uploaded device batches) in the process-wide cache
+            slots, schema = self.spec.slots, self._schema
+            self._scalar_jit = shared_callable(
+                ("agg_scalar_state",) + self._spec_cache_key(),
+                lambda: jax.jit(
+                    lambda b: _scalar_state_program(slots, schema, b)
+                ),
             )
-            cols.append(arr)
-        valid = jnp.zeros(2048, dtype=bool).at[0].set(True)
-        null_masks = []
-        for nl in nulls:
-            if nl is None:
-                null_masks.append(None)
-            else:
-                null_masks.append(jnp.zeros(2048, dtype=bool).at[0].set(nl))
-        return DeviceBatch(
-            schema=self._schema,
-            columns=tuple(cols),
-            valid=valid,
-            nulls=tuple(null_masks),
-            dictionaries={},
-        )
+        return self._scalar_jit
 
     def _execute_final(
         self, partition: int, ctx: TaskContext, cap: int, n_groups: int
@@ -1180,22 +1247,33 @@ class HashAggregateExec(ExecutionPlan):
             # (eagerly this is ~15 separate dispatches — each a round
             # trip on a tunnelled chip, dominating short queries)
             if getattr(self, "_scalar_final_jit", None) is None:
+                from ballista_tpu.compilecache import shared_callable
 
-                def scalar_final(sts):
-                    merged = (
-                        concat_batches(sts) if len(sts) > 1 else sts[0]
-                    )
-                    outs, nulls = scalar_aggregate(
-                        merged.valid,
-                        [merged.columns[i]
-                         for i in range(len(self.spec.slots))],
-                        [merged.nulls[i]
-                         for i in range(len(self.spec.slots))],
-                        merge_ops,
-                    )
-                    return self._finalize_scalar(outs, nulls)
+                # close over derived values only (see _scalar_state_fn):
+                # the process-wide cache must not pin the plan subtree
+                n_slots = len(self.spec.slots)
+                finals, schema = self.spec.finals, self._schema
 
-                self._scalar_final_jit = jax.jit(scalar_final)
+                def build():
+                    def scalar_final(sts):
+                        merged = (
+                            concat_batches(sts) if len(sts) > 1 else sts[0]
+                        )
+                        outs, nulls = scalar_aggregate(
+                            merged.valid,
+                            [merged.columns[i] for i in range(n_slots)],
+                            [merged.nulls[i] for i in range(n_slots)],
+                            merge_ops,
+                        )
+                        return _finalize_scalar_program(
+                            finals, schema, outs, nulls
+                        )
+
+                    return jax.jit(scalar_final)
+
+                self._scalar_final_jit = shared_callable(
+                    ("agg_scalar_final",) + self._spec_cache_key(), build
+                )
             with self.metrics.time("merge_time"):
                 yield self._scalar_final_jit(states)
             return
@@ -1469,35 +1547,3 @@ class HashAggregateExec(ExecutionPlan):
     def _finalize(self, state: DeviceBatch, n_groups: int) -> DeviceBatch:
         return finalize_state(state, self.spec, self._schema)
 
-    def _finalize_scalar(self, outs, nulls) -> DeviceBatch:
-        cap = 2048
-        cols, null_masks = [], []
-        n_slots = len(self.spec.slots)
-        for name, dtype, idxs, kind in self.spec.finals:
-            if kind == "avg":
-                s, c = outs[idxs[0]], outs[idxs[1]]
-                v = s.astype(jnp.float64) / jnp.maximum(c, 1).astype(jnp.float64)
-                nl = c == 0
-            elif kind in (
-                "var_samp", "var_pop", "stddev_samp", "stddev_pop", "corr"
-            ):
-                v, nl = _stat_final(lambda i: outs[i], idxs, kind)
-            else:
-                v = outs[idxs[0]]
-                nl = nulls[idxs[0]]
-            arr = jnp.zeros(cap, dtype=dtype.to_np()).at[0].set(
-                v.astype(dtype.to_np())
-            )
-            cols.append(arr)
-            if nl is None:
-                null_masks.append(None)
-            else:
-                null_masks.append(jnp.zeros(cap, dtype=bool).at[0].set(nl))
-        valid = jnp.zeros(cap, dtype=bool).at[0].set(True)
-        return DeviceBatch(
-            schema=self._schema,
-            columns=tuple(cols),
-            valid=valid,
-            nulls=tuple(null_masks),
-            dictionaries={},
-        )

@@ -367,7 +367,19 @@ def run_with_capacity_retry(
             ctx.clean_commits.clear()
             base = override or config.agg_capacity()
             need = max(e.required + 1, base * 2)
-            new_cap = 1 << (need - 1).bit_length()
+            # grown capacities snap to the capacity-bucket ladder: an
+            # adaptive retry then lands on the same compiled-program
+            # signature as every other operator at that bucket instead of
+            # minting a fresh power-of-two vocabulary entry
+            # (docs/compile_cache.md)
+            from ballista_tpu.columnar.batch import round_capacity
+
+            new_cap = round_capacity(need)
+            if need <= AGG_CAPACITY_HARD_MAX < new_cap:
+                # a coarse ladder (e.g. 2048:3) can overshoot the hard
+                # max on a need the old pow2 growth served; the clamped
+                # capacity is off-ladder but the retry still succeeds
+                new_cap = AGG_CAPACITY_HARD_MAX
             if new_cap > AGG_CAPACITY_HARD_MAX or (
                 override is not None and new_cap <= override
             ):

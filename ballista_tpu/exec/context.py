@@ -60,6 +60,20 @@ class TpuContext(Catalog, TableProvider):
         from ballista_tpu.plugin import load_plugins
 
         load_plugins(self.config.plugin_dir() or None)
+        # compile-latency subsystem (docs/compile_cache.md): install the
+        # configured capacity-bucket ladder before any batch is built, and
+        # optionally AOT-prewarm the kernel vocabulary (latched process-
+        # wide; 'background' threads wind down on their own — see
+        # compilecache.prewarm)
+        from ballista_tpu.columnar.batch import set_capacity_buckets
+        from ballista_tpu.compilecache import metrics as compile_metrics
+        from ballista_tpu.compilecache import start_prewarm
+
+        compile_metrics.install()
+        set_capacity_buckets(self.config.capacity_buckets())
+        self._prewarm = start_prewarm(
+            self.config.prewarm(), max_rows=self.config.tpu_batch_rows()
+        )
         self.tables: dict[str, _Registered] = {}
         self._mesh_runtime = None
         self._mesh_checked = False
@@ -68,6 +82,12 @@ class TpuContext(Catalog, TableProvider):
         # cross-query plan-shape speculation cache (join strategies,
         # expansion capacities); cleared whenever table data changes
         self._plan_cache: dict = {}
+        # persisted hints (compilecache/hints.py): loaded lazily at the
+        # FIRST collect — registration clears _plan_cache, so an eager
+        # load here would be wiped before the first query sees it
+        from ballista_tpu.compilecache.hints import HintStore
+
+        self._hints = HintStore()
         # physical plans cached by (optimized-logical display, config
         # digest): repeated query texts reuse the SAME operator instances
         # and therefore their jitted programs — otherwise every query
@@ -623,10 +643,17 @@ class DataFrame:
         # run_with_capacity_retry raises deferred device checks in one
         # batched fetch and, on aggregate-capacity overflow, re-runs the
         # plan with the capacity grown to the reported group count; the
-        # context-level hint makes warm re-runs start at the grown size
+        # context-level hint makes warm re-runs start at the grown size,
+        # and the persisted hint file makes COLD runs start there too
+        self.ctx._hints.load_once(
+            self.ctx._capacity_hint, self.ctx._plan_cache
+        )
         record_batches = run_with_capacity_retry(
             self.ctx.config, run, hint=self.ctx._capacity_hint,
             plan_cache=self.ctx._plan_cache
+        )
+        self.ctx._hints.save_if_changed(
+            self.ctx._capacity_hint, self.ctx._plan_cache
         )
         if not record_batches:
             from ballista_tpu.columnar.arrow_interop import schema_to_arrow

@@ -117,7 +117,6 @@ class HashJoinExec(ExecutionPlan):
         self.join_type = join_type
         self.filter = filter
         self.partition_mode = partition_mode
-        self._filtered_probe_cache: dict = {}
         self._build_cache: dict = {}
         # build-strategy flags (dups/overflow of the collected right side)
         # are partition-invariant: compute once, reuse across partitions
@@ -1066,9 +1065,9 @@ class HashJoinExec(ExecutionPlan):
             first, count, live = _jit_counts(tuple(probe_keys))(bt, probe)
 
         if kind in (JoinSide.SEMI, JoinSide.ANTI) and self.filter is None:
-            key = (tuple(probe_keys), kind, "semi_counts")
-            fn = self._filtered_probe_cache.get(key)
-            if fn is None:
+            from ballista_tpu.compilecache import shared_callable
+
+            def build():
                 keep_match = kind == JoinSide.SEMI
 
                 def fn(pb, count):
@@ -1077,8 +1076,11 @@ class HashJoinExec(ExecutionPlan):
                         pb.valid & (m if keep_match else ~m)
                     )
 
-                fn = jax.jit(fn)
-                self._filtered_probe_cache[key] = fn
+                return jax.jit(fn)
+
+            fn = shared_callable(
+                ("join_semi_counts", tuple(probe_keys), kind), build
+            )
             with self.metrics.time("probe_time"):
                 return fn(probe, count)
 
@@ -1112,9 +1114,14 @@ class HashJoinExec(ExecutionPlan):
                 cache[cap_key] = max(out_cap, cache.get(cap_key) or 0)
                 synced.add(cap_key)
 
-        key = (tuple(probe_keys), kind, out_cap)
-        fn = self._filtered_probe_cache.get(key)
-        if fn is None:
+        from ballista_tpu.compilecache import expr_key, shared_callable
+
+        key = (
+            "join_expand", tuple(probe_keys), kind, out_cap,
+            expr_key(self.filter),
+        )
+
+        def build():
             filt = self.filter
 
             def run(bt, pb, first, count):
@@ -1166,8 +1173,9 @@ class HashJoinExec(ExecutionPlan):
                     dictionaries=dict(batch.dictionaries),
                 )
 
-            fn = jax.jit(run)
-            self._filtered_probe_cache[key] = fn
+            return jax.jit(run)
+
+        fn = shared_callable(key, build)
         with self.metrics.time("probe_time"):
             return fn(bt, probe, first, count)
 
@@ -1206,9 +1214,14 @@ class HashJoinExec(ExecutionPlan):
                 return _jit_probe(tuple(probe_keys), kind, contiguous)(
                     bt, probe
                 )
-        key = (tuple(probe_keys), kind, contiguous)
-        fn = self._filtered_probe_cache.get(key)
-        if fn is None:
+        from ballista_tpu.compilecache import expr_key, shared_callable
+
+        key = (
+            "join_probe_filter", tuple(probe_keys), kind, contiguous,
+            expr_key(self.filter),
+        )
+
+        def build():
             filt = self.filter
             pk = list(probe_keys)
 
@@ -1248,8 +1261,9 @@ class HashJoinExec(ExecutionPlan):
                     dictionaries=dict(joined.dictionaries),
                 )
 
-            fn = jax.jit(run)
-            self._filtered_probe_cache[key] = fn
+            return jax.jit(run)
+
+        fn = shared_callable(key, build)
         with self.metrics.time("probe_time"):
             return fn(bt, probe)
 

@@ -90,17 +90,27 @@ def fusable_chain(plan: ExecutionPlan):
 
 def fused_batch_fn(ops: list) -> Callable[[DeviceBatch], DeviceBatch]:
     """One jitted program for the whole chain (inner jits inline when the
-    composition is traced)."""
+    composition is traced). Shared across plan instances by the chain's
+    canonical signature: the executor decodes a fresh plan per task, and
+    without sharing every attempt/repeat re-traced the whole chain
+    (compilecache/tracecache.py)."""
     fns = [op.batch_fn() for op in ops]
     if len(fns) == 1:
         return fns[0]
 
-    def run(batch: DeviceBatch) -> DeviceBatch:
-        for f in fns:
-            batch = f(batch)
-        return batch
+    from ballista_tpu.compilecache import shared_callable
 
-    return jax.jit(run)
+    def build():
+        def run(batch: DeviceBatch) -> DeviceBatch:
+            for f in fns:
+                batch = f(batch)
+            return batch
+
+        return jax.jit(run)
+
+    return shared_callable(
+        ("fused_chain",) + tuple(op._cache_key() for op in ops), build
+    )
 
 
 class _FusedPipeline:
@@ -164,18 +174,32 @@ class FilterExec(_FusedPipeline, ExecutionPlan):
     def describe(self) -> str:
         return f"FilterExec: {self.predicate.name()}"
 
+    def _cache_key(self) -> tuple:
+        from ballista_tpu.compilecache import expr_key, schema_key
+
+        return (
+            "filter",
+            expr_key(self.predicate),
+            schema_key(self.input.schema()),
+        )
+
     def batch_fn(self) -> Callable[[DeviceBatch], DeviceBatch]:
         if self._fn is None:
-            phys = compile_expr(self.predicate, self.input.schema())
+            from ballista_tpu.compilecache import shared_callable
 
-            def run(batch: DeviceBatch) -> DeviceBatch:
-                cv = phys.evaluate(batch)
-                keep = cv.values.astype(bool)
-                if cv.nulls is not None:
-                    keep = keep & ~cv.nulls  # NULL predicate = drop row
-                return batch.with_valid(batch.valid & keep)
+            def build():
+                phys = compile_expr(self.predicate, self.input.schema())
 
-            self._fn = jax.jit(run)
+                def run(batch: DeviceBatch) -> DeviceBatch:
+                    cv = phys.evaluate(batch)
+                    keep = cv.values.astype(bool)
+                    if cv.nulls is not None:
+                        keep = keep & ~cv.nulls  # NULL predicate = drop row
+                    return batch.with_valid(batch.valid & keep)
+
+                return jax.jit(run)
+
+            self._fn = shared_callable(self._cache_key(), build)
         return self._fn
 
 class ProjectionExec(_FusedPipeline, ExecutionPlan):
@@ -203,35 +227,51 @@ class ProjectionExec(_FusedPipeline, ExecutionPlan):
     def describe(self) -> str:
         return "ProjectionExec: " + ", ".join(e.name() for e in self.exprs)
 
+    def _cache_key(self) -> tuple:
+        from ballista_tpu.compilecache import expr_key, schema_key
+
+        return (
+            "project",
+            tuple(expr_key(e) for e in self.exprs),
+            schema_key(self.input.schema()),
+        )
+
     def batch_fn(self) -> Callable[[DeviceBatch], DeviceBatch]:
         if self._fn is None:
+            from ballista_tpu.compilecache import shared_callable
+
             ins = self.input.schema()
-            phys = [compile_expr(e, ins) for e in self.exprs]
             out_schema = self._schema
 
-            def run(batch: DeviceBatch) -> DeviceBatch:
-                cols, nulls, dicts = [], [], {}
-                import numpy as np
+            def build():
+                phys = [compile_expr(e, ins) for e in self.exprs]
 
-                for field, p in zip(out_schema, phys):
-                    cv = p.evaluate(batch)
-                    vals = cv.values
-                    want = field.dtype.to_np()
-                    if vals.dtype != want and not (
-                        want == np.int64 and vals.dtype == np.int32
-                    ):
-                        # int32 is a permitted physical form of a logical
-                        # INT64 column (arrow_interop narrowing) — widening
-                        # it here would undo the narrowing right before the
-                        # sorts/gathers it exists for
-                        vals = vals.astype(want)
-                    cols.append(vals)
-                    nulls.append(cv.nulls)
-                    if cv.dictionary is not None:
-                        dicts[field.name] = cv.dictionary
-                return batch.with_columns(out_schema, cols, nulls, dicts)
+                def run(batch: DeviceBatch) -> DeviceBatch:
+                    cols, nulls, dicts = [], [], {}
+                    import numpy as np
 
-            self._fn = jax.jit(run)
+                    for field, p in zip(out_schema, phys):
+                        cv = p.evaluate(batch)
+                        vals = cv.values
+                        want = field.dtype.to_np()
+                        if vals.dtype != want and not (
+                            want == np.int64 and vals.dtype == np.int32
+                        ):
+                            # int32 is a permitted physical form of a
+                            # logical INT64 column (arrow_interop
+                            # narrowing) — widening it here would undo the
+                            # narrowing right before the sorts/gathers it
+                            # exists for
+                            vals = vals.astype(want)
+                        cols.append(vals)
+                        nulls.append(cv.nulls)
+                        if cv.dictionary is not None:
+                            dicts[field.name] = cv.dictionary
+                    return batch.with_columns(out_schema, cols, nulls, dicts)
+
+                return jax.jit(run)
+
+            self._fn = shared_callable(self._cache_key(), build)
         return self._fn
 
 

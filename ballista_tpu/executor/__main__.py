@@ -78,6 +78,14 @@ def build_parser() -> argparse.ArgumentParser:
         default=float(_env("job_data_clean_up_interval_seconds", 0)),
         help="0 disables the cleanup loop (ref main.rs:188-203)",
     )
+    p.add_argument(
+        "--prewarm",
+        default=_env("prewarm", os.environ.get("BALLISTA_TPU_PREWARM", "off")),
+        choices=["off", "on", "background"],
+        help="AOT-compile the kernel vocabulary at start "
+        "(docs/compile_cache.md): 'on' blocks until warm, 'background' "
+        "compiles while serving",
+    )
     p.add_argument("--log-level", default=_env("log_level", "INFO"))
     return p
 
@@ -87,6 +95,13 @@ def main(argv: list[str] | None = None) -> int:
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper(), logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    # re-log the import-time cache decision now that a handler exists
+    import ballista_tpu
+
+    log.info(
+        "jax persistent compilation cache: %s",
+        ballista_tpu.jax_cache_dir or "disabled",
     )
     work_dir = args.work_dir or tempfile.mkdtemp(prefix="ballista-executor-")
     os.makedirs(work_dir, exist_ok=True)
@@ -112,6 +127,7 @@ def main(argv: list[str] | None = None) -> int:
             args.external_host,
             flight_port,
             task_slots=args.concurrent_tasks,
+            prewarm=args.prewarm,
         )
         grpc_port = server.startup(args.bind_host, args.bind_grpc_port)
         log.info("push-mode ExecutorGrpc on %s:%d", args.bind_host, grpc_port)
@@ -123,6 +139,7 @@ def main(argv: list[str] | None = None) -> int:
             args.external_host,
             flight_port,
             task_slots=args.concurrent_tasks,
+            prewarm=args.prewarm,
         )
         loop.start()
         worker = loop

@@ -67,9 +67,15 @@ class Executor:
         # turns this off: in-proc, the scheduler just verified the same
         # bytes it hands over, so the second walk buys nothing.
         self.verify_decoded_plans = True
-        # adaptive-capacity memory across tasks (run_with_capacity_retry)
+        # adaptive-capacity memory across tasks (run_with_capacity_retry),
+        # seeded from the persisted hint file so an executor restart keeps
+        # its learned join strategies / capacities (docs/compile_cache.md)
         self._capacity_hint: dict = {}
         self._plan_cache: dict = {}
+        from ballista_tpu.compilecache.hints import HintStore
+
+        self._hints = HintStore()
+        self._hints.load_once(self._capacity_hint, self._plan_cache)
         from ballista_tpu.executor.metrics import LoggingMetricsCollector
 
         self.metrics_collector = metrics_collector or LoggingMetricsCollector()
@@ -210,6 +216,13 @@ class Executor:
             )
         props = props_early
         config = BallistaConfig(props) if props else BallistaConfig()
+        # shape canonicalization (docs/compile_cache.md): the session's
+        # capacity-bucket ladder must govern THIS executor's static shapes
+        # too, or client and executor would compile disjoint vocabularies
+        # for the same query (latched no-op when the spec is unchanged)
+        from ballista_tpu.columnar.batch import set_capacity_buckets
+
+        set_capacity_buckets(config.capacity_buckets())
         if self.verify_decoded_plans and config.verify_plans():
             from ballista_tpu.analysis import verify_physical
 
@@ -242,6 +255,7 @@ class Executor:
             ),
         )
         self._plan_cache.update(attempt_cache)
+        self._hints.save_if_changed(self._capacity_hint, self._plan_cache)
         self.metrics_collector.record_stage(
             task.task_id.job_id, task.task_id.stage_id,
             task.task_id.partition_id, plan,
@@ -285,6 +299,7 @@ class PollLoop:
         flight_host: str,
         flight_port: int,
         task_slots: int = 4,
+        prewarm: str | None = None,
     ):
         self.executor = executor
         self.scheduler_addr = scheduler_addr
@@ -300,8 +315,17 @@ class PollLoop:
         self._statuses: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # AOT kernel prewarm (docs/compile_cache.md); mode resolution and
+        # the start sequence are shared with ExecutorServer
+        from ballista_tpu.compilecache import prewarm as prewarm_mod
+
+        self.prewarm_mode = prewarm_mod.resolve_mode(prewarm)
+        self._prewarm = None
 
     def start(self) -> None:
+        from ballista_tpu.compilecache.prewarm import start_server_prewarm
+
+        self._prewarm = start_server_prewarm(self.prewarm_mode)
         self._thread = threading.Thread(
             target=self.run, daemon=True, name="executor-poll-loop"
         )
@@ -309,6 +333,11 @@ class PollLoop:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._prewarm is not None:
+            # zero-thread-leak shutdown: cancel queued prewarm compiles
+            # and join the pool (tests/test_shutdown_hygiene.py)
+            self._prewarm.stop()
+            self._prewarm = None
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.executor.close_locations_client()
@@ -359,12 +388,20 @@ class PollLoop:
             can_accept = self._available.acquire(blocking=False)
             if can_accept:
                 self._available.release()
+            from ballista_tpu.compilecache import metrics as compile_metrics
+
             try:
                 result = stub.PollWork(
                     pb.PollWorkParams(
                         metadata=self._metadata(),
                         can_accept_task=can_accept,
                         task_status=statuses,
+                        # compile-latency observability: pull-mode liveness
+                        # IS the poll, so the counter snapshot rides it
+                        metrics=[
+                            pb.KeyValuePair(key=k, value=str(v))
+                            for k, v in compile_metrics.snapshot().items()
+                        ],
                     )
                 )
             except grpc.RpcError as e:

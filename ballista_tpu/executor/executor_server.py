@@ -56,8 +56,15 @@ class ExecutorServer:
         flight_port: int,
         task_slots: int = 4,
         heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S,
+        prewarm: str | None = None,
     ) -> None:
         self.executor = executor
+        # AOT kernel prewarm (docs/compile_cache.md); mode resolution and
+        # the start sequence are shared with PollLoop
+        from ballista_tpu.compilecache import prewarm as prewarm_mod
+
+        self.prewarm_mode = prewarm_mod.resolve_mode(prewarm)
+        self._prewarm = None
         self.scheduler_addr = scheduler_addr
         # eager shuffle: the executor core polls published map-output
         # locations from the same scheduler this server reports to
@@ -92,6 +99,15 @@ class ExecutorServer:
         """Start service + register + heartbeater + runner pool. Returns
         the bound grpc port (ref startup :49-108)."""
         from concurrent.futures import ThreadPoolExecutor
+
+        # compile-latency subsystem: counters on from the first task, and
+        # (when configured) the kernel vocabulary AOT-compiling while the
+        # control plane comes up — 'on' blocks here so the scheduler never
+        # offers slots to a cold executor, 'background' overlaps warm-up
+        # with registration and is joined in stop()
+        from ballista_tpu.compilecache.prewarm import start_server_prewarm
+
+        self._prewarm = start_server_prewarm(self.prewarm_mode)
 
         gs = grpc.server(ThreadPoolExecutor(max_workers=8))
         add_service(gs, EXECUTOR_SERVICE, EXECUTOR_METHODS, self)
@@ -143,9 +159,20 @@ class ExecutorServer:
                 # injected blackout: the scheduler's expiry sweep must see
                 # this executor go silent
                 continue
+            from ballista_tpu.compilecache import metrics as compile_metrics
+
             try:
                 result = self._sched.HeartBeatFromExecutor(
-                    pb.HeartBeatParams(executor_id=self.executor.executor_id),
+                    pb.HeartBeatParams(
+                        executor_id=self.executor.executor_id,
+                        # compile-latency observability: the cumulative
+                        # counter snapshot rides every beat; the scheduler
+                        # stores the latest per executor (REST /api/state)
+                        metrics=[
+                            pb.KeyValuePair(key=k, value=str(v))
+                            for k, v in compile_metrics.snapshot().items()
+                        ],
+                    ),
                     timeout=RPC_TIMEOUT_S,
                 )
                 if result.reregister:
@@ -206,6 +233,13 @@ class ExecutorServer:
         daemon threads would leak across start/stop cycles and could
         race a half-closed channel with their final UpdateTaskStatus."""
         self._stop.set()
+        if self._prewarm is not None:
+            # cancel queued prewarm compiles and join the pool threads
+            # BEFORE the thread audit below — the zero-thread-leak
+            # shutdown contract (tests/test_shutdown_hygiene.py) covers
+            # prewarm workers too
+            self._prewarm.stop()
+            self._prewarm = None
         stragglers = []
         for t in self._threads:
             t.join(timeout=5)

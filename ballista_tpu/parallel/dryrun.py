@@ -148,6 +148,22 @@ def main() -> None:
         raise SystemExit(
             f"{len(hazards)} JAX hazards (see planlint output above)"
         )
+    # closed-vocabulary gate (docs/compile_cache.md): the same report is
+    # the source of truth for compilecache.registry — a jit site that is
+    # not registered there is an undeclared cold-start compile surface
+    from ballista_tpu.compilecache import registry
+
+    problems = registry.check_vocabulary(report)
+    for p in problems:
+        print(f"  VOCABULARY {p}")
+    if problems:
+        raise SystemExit(
+            f"{len(problems)} compile-vocabulary findings (see above)"
+        )
+    print(
+        f"compile-vocab: {len(registry.VOCABULARY)} kernels registered, "
+        "report closed"
+    )
     print(f"dryrun ok on {n} devices")
 
 

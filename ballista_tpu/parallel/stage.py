@@ -386,7 +386,7 @@ class MeshStageRunner:
                     "mesh sort bucket overflow after retries",
                     required=per * self.n_dev,
                 )
-            bcap *= 2
+            bcap = round_capacity(bcap * 2)  # stay on the bucket ladder
         return DeviceBatch(
             schema=batch.schema,
             columns=tuple(out_cols),
@@ -666,12 +666,15 @@ class MeshStageRunner:
                     "or reduce build size"
                 )
             if np.any(bucket_ovf):
-                bcap *= 2
-                ocap = max(ocap, self.n_dev * bcap)
+                # grown capacities snap to the bucket ladder (like the
+                # exec/base.py retry path) so mesh retries land on shared
+                # compiled-program signatures under non-pow2 ladders too
+                bcap = round_capacity(bcap * 2)
+                ocap = max(ocap, round_capacity(self.n_dev * bcap))
                 continue
             if np.any(exp_ovf):
                 required = int(np.max(totals))
-                ocap = max(round_capacity(required + 1), ocap * 2)
+                ocap = round_capacity(max(required + 1, ocap * 2))
                 continue
             break
         else:

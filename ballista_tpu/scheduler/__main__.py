@@ -84,6 +84,13 @@ def main(argv: list[str] | None = None) -> int:
         level=getattr(logging, args.log_level.upper(), logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
+    # re-log the import-time cache decision now that a handler exists
+    import ballista_tpu
+
+    log.info(
+        "jax persistent compilation cache: %s",
+        ballista_tpu.jax_cache_dir or "disabled",
+    )
     from ballista_tpu.scheduler.server import (
         SchedulerServer,
         start_scheduler_grpc,

@@ -19,6 +19,9 @@ class ExecutorManager:
         self._heartbeats: dict[str, float] = {}
         self._metadata: dict[str, ExecutorMetadata] = {}
         self._data: dict[str, ExecutorData] = {}
+        # latest compile-latency counter snapshot per executor (ridden in
+        # on HeartBeatParams/PollWorkParams.metrics; docs/compile_cache.md)
+        self._metrics: dict[str, dict[str, float]] = {}
 
     def save_executor_metadata(self, meta: ExecutorMetadata) -> None:
         with self._lock:
@@ -35,6 +38,20 @@ class ExecutorManager:
     def save_executor_heartbeat(self, executor_id: str) -> None:
         with self._lock:
             self._heartbeats[executor_id] = time.time()
+
+    def save_executor_metrics(
+        self, executor_id: str, metrics: dict[str, float]
+    ) -> None:
+        """Store the latest counter snapshot (replace, not merge: the
+        executor sends cumulative process-wide counters)."""
+        if not metrics:
+            return
+        with self._lock:
+            self._metrics[executor_id] = dict(metrics)
+
+    def get_executor_metrics(self, executor_id: str) -> dict[str, float]:
+        with self._lock:
+            return dict(self._metrics.get(executor_id, ()))
 
     def last_seen(self, executor_id: str) -> float | None:
         with self._lock:
@@ -82,6 +99,7 @@ class ExecutorManager:
         with self._lock:
             self._data.pop(executor_id, None)
             self._heartbeats.pop(executor_id, None)
+            self._metrics.pop(executor_id, None)
 
     def get_available_executors_data(
         self, timeout: float = DEFAULT_EXECUTOR_TIMEOUT_SECONDS

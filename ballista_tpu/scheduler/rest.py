@@ -215,6 +215,13 @@ def scheduler_state(server) -> dict:
                 "last_seen_seconds_ago": (
                     round(now - seen, 3) if seen is not None else None
                 ),
+                # latest compile-latency counters (traces, XLA compiles,
+                # persistent-cache hits/misses, prewarm progress) the
+                # executor shipped on its heartbeat/poll
+                # (docs/compile_cache.md)
+                "compile": server.executor_manager.get_executor_metrics(
+                    em.id
+                ),
             }
         )
     with server._lock:

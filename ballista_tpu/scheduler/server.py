@@ -1220,6 +1220,9 @@ class SchedulerGrpcServicer:
         )
         self.s.executor_manager.save_executor_metadata(em)
         self.s.executor_manager.save_executor_heartbeat(meta.id)
+        self.s.executor_manager.save_executor_metrics(
+            meta.id, {kv.key: float(kv.value) for kv in request.metrics}
+        )
         self.s.persist_executor(em)
         if self.s.executor_manager.get_executor_data(meta.id) is None:
             self.s.executor_manager.save_executor_data(
@@ -1285,6 +1288,10 @@ class SchedulerGrpcServicer:
 
     def HeartBeatFromExecutor(self, request, context):
         self.s.executor_manager.save_executor_heartbeat(request.executor_id)
+        self.s.executor_manager.save_executor_metrics(
+            request.executor_id,
+            {kv.key: float(kv.value) for kv in request.metrics},
+        )
         # an executor the expiry sweep dropped (or a scheduler that restarted
         # without its registration) must re-register to get slots back
         reregister = (

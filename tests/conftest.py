@@ -25,6 +25,14 @@ import pytest
 for _k in ("BALLISTA_FAULTS", "BALLISTA_FAULTS_SEED"):
     os.environ.pop(_k, None)
 
+# Hermetic plan-hint persistence: without this, every in-test TpuContext/
+# Executor would read AND write the developer's real hint file
+# (compilecache/hints.py rides the XLA cache dir), making test behavior
+# depend on prior runs. Tests that exercise persistence point
+# BALLISTA_TPU_HINT_CACHE at a tmp dir themselves. Set BEFORE the
+# CPU_MESH_ENV snapshot so subprocess tests inherit the isolation.
+os.environ["BALLISTA_TPU_HINT_CACHE"] = "off"
+
 # Environment for subprocesses that need an 8-device virtual CPU mesh.
 CPU_MESH_ENV = {
     **{k: v for k, v in os.environ.items() if not k.startswith(("PALLAS_AXON", "AXON"))},

@@ -458,7 +458,9 @@ def test_combined_analysis_gate_is_clean():
     lines: list[str] = []
     rc = run_all(queries=[1, 3, 6], out=lines.append)
     assert rc == 0, "\n".join(lines)
-    for name in ("planlint", "serde-audit", "jaxlint", "racelint"):
+    for name in (
+        "planlint", "serde-audit", "jaxlint", "racelint", "compile-vocab"
+    ):
         assert any(ln.startswith(f"{name}: OK") for ln in lines), lines
 
 
