@@ -1,6 +1,6 @@
-"""Static analysis subsystem (``planlint``/``racelint``).
+"""Static analysis subsystem (``planlint``/``racelint``/``lifelint``).
 
-Four passes that move whole classes of executor-runtime failures to
+Passes that move whole classes of executor-runtime failures to
 submission/collection time — run together via
 ``python -m ballista_tpu.analysis``:
 
@@ -22,6 +22,19 @@ submission/collection time — run together via
   :mod:`ballista_tpu.analysis.statemachine` and a runtime lock-order
   witness in :mod:`ballista_tpu.analysis.witness`
   (``BALLISTA_LOCK_WITNESS=1``).
+- :mod:`ballista_tpu.analysis.lifelint` — resource-lifecycle + error-
+  taxonomy lint over the control & data planes (leaked
+  channels/pools/files/mmaps/spill sets, releases missing from
+  exception/cancellation edges, raises outside the errors.py
+  retryable/non-retryable taxonomy, swallowed errors, untyped
+  fault-injection handlers), with a runtime resource witness in
+  :mod:`ballista_tpu.analysis.reswitness`
+  (``BALLISTA_RESOURCE_WITNESS=1``).
+- :mod:`ballista_tpu.analysis.protodrift` — proto text ↔ generated
+  descriptor agreement (protoc-less descriptor mutations) plus the
+  committed field-number ledger (``proto/field_numbers.json``).
+- :mod:`ballista_tpu.analysis.configlint` — config-key & env-var
+  registry closure with the generated ``docs/config.md`` table.
 """
 
 from ballista_tpu.errors import PlanVerificationError  # noqa: F401

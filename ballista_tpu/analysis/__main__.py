@@ -18,10 +18,25 @@ line — the single command CI (and a developer pre-push) needs:
   logical→physical→stage lowering must declare its compile surface — a
   silently-grown recompile vocabulary is a cold-start regression
   (docs/compile_cache.md).
+- **lifelint** — resource-lifecycle + error-taxonomy lint over the
+  control & data planes (leaked channels/pools/files/mmaps/spill sets,
+  releases missing from exception/cancellation edges, raises outside
+  the errors.py retryable/non-retryable taxonomy, swallowed errors,
+  untyped fault-injection handlers), with its runtime counterpart in
+  :mod:`ballista_tpu.analysis.reswitness`
+  (``BALLISTA_RESOURCE_WITNESS=1``).
+- **proto-drift** — proto TEXT ↔ generated DESCRIPTOR agreement (the
+  image has no protoc; edits are hand-synced descriptor mutations) plus
+  the committed field-number ledger (proto/field_numbers.json): no
+  renumber, no reuse of retired numbers, every new field appended.
+- **config-registry** — every ``ballista.*`` config-key literal and
+  ``BALLISTA_*`` env read site must resolve to a declared registry
+  entry, and docs/config.md must match the generated table.
 
 Flags: ``--dot`` prints the racelint lock-order graph (Graphviz) and
 exits; ``--tables`` prints the canonical status state machines and
-exits; ``--skip a,b`` / ``--only a,b`` select analyzers;
+exits; ``--write-config-docs`` regenerates docs/config.md and exits;
+``--skip a,b`` / ``--only a,b`` select analyzers;
 ``--queries 1,3,6`` limits planlint's TPC-H corpus (tier-1 runs a
 subset — the full corpus is covered by tests/test_plan_verifier.py).
 """
@@ -32,7 +47,8 @@ import argparse
 import sys
 
 ANALYZERS = (
-    "planlint", "serde-audit", "jaxlint", "racelint", "compile-vocab"
+    "planlint", "serde-audit", "jaxlint", "racelint", "compile-vocab",
+    "lifelint", "proto-drift", "config-registry",
 )
 
 
@@ -164,6 +180,34 @@ def run_compile_vocab(queries=None) -> tuple[bool, str]:
     )
 
 
+def run_lifelint() -> tuple[bool, str]:
+    from ballista_tpu.analysis import lifelint
+
+    diags = lifelint.lint_paths()
+    sup = lifelint.suppression_count()
+    transfers = lifelint.transfer_sites()
+    if diags:
+        return False, "\n".join(str(d) for d in diags)
+    if sup > 5:
+        return False, f"suppression budget exceeded: {sup} > 5"
+    return True, (
+        f"0 findings, {sup} suppressions, {len(transfers)} declared "
+        "ownership transfers"
+    )
+
+
+def run_proto_drift() -> tuple[bool, str]:
+    from ballista_tpu.analysis import protodrift
+
+    return protodrift.run()
+
+
+def run_config_registry() -> tuple[bool, str]:
+    from ballista_tpu.analysis import configlint
+
+    return configlint.run()
+
+
 def run_all(
     skip=(), only=(), queries=None, out=print
 ) -> int:
@@ -174,6 +218,9 @@ def run_all(
         "jaxlint": run_jaxlint,
         "racelint": run_racelint,
         "compile-vocab": lambda: run_compile_vocab(queries),
+        "lifelint": run_lifelint,
+        "proto-drift": run_proto_drift,
+        "config-registry": run_config_registry,
     }
     failed = []
     for name in ANALYZERS:
@@ -209,7 +256,18 @@ def main(argv=None) -> int:
         "--tables", action="store_true",
         help="print the canonical status state machines and exit",
     )
+    ap.add_argument(
+        "--write-config-docs", action="store_true",
+        help="regenerate docs/config.md from the config registries and "
+        "exit",
+    )
     args = ap.parse_args(argv)
+    if args.write_config_docs:
+        from ballista_tpu.analysis import configlint
+
+        configlint.docs_path().write_text(configlint.render_config_docs())
+        print(f"wrote {configlint.docs_path()}")
+        return 0
     if args.dot:
         from ballista_tpu.analysis import racelint
 

@@ -42,8 +42,13 @@ class BallistaContext(TpuContext):
         config: BallistaConfig | None = None,
     ):
         super().__init__(config)
+        from ballista_tpu.analysis import reswitness
+
         self.scheduler_addr = scheduler_addr
         self._channel = grpc.insecure_channel(scheduler_addr)
+        self._channel_token = reswitness.acquire(
+            "grpc-channel", f"client->{scheduler_addr}"
+        )
         self._stub = scheduler_stub(self._channel)
         # create a server-side session (ref context.rs:83-135)
         result = self._stub.ExecuteQuery(
@@ -101,9 +106,13 @@ class BallistaContext(TpuContext):
         return ctx
 
     def close(self) -> None:
+        from ballista_tpu.analysis import reswitness
+
         if self._standalone_cluster is not None:
             self._standalone_cluster.stop()
         self._channel.close()
+        reswitness.release(self._channel_token)
+        self._channel_token = None
 
     def _frame(self, logical: LogicalPlan) -> DataFrame:
         return RemoteDataFrame(self, logical)

@@ -60,6 +60,9 @@ _TRANSIENT_FLIGHT_ERRORS = (
 )
 
 _POOL: dict[tuple[str, int], paflight.FlightClient] = {}
+# witness tokens for pooled clients (analysis/reswitness.py), keyed like
+# the pool and mutated under the same lock
+_POOL_TOKENS: dict[tuple[str, int], object] = {}
 _POOL_LOCK = make_lock("flight._POOL_LOCK")
 
 
@@ -72,12 +75,15 @@ def _client_for(host: str, port: int) -> paflight.FlightClient:
     fetch thread — across healthy peers — behind the global lock. Two
     threads racing the first dial both connect; the loser's channel is
     closed (nobody else can have seen it)."""
+    from ballista_tpu.analysis import reswitness
+
     key = (host, port)
     with _POOL_LOCK:
         client = _POOL.get(key)
     if client is not None:
         return client
     client = paflight.connect(f"grpc://{host}:{port}")
+    tok = reswitness.acquire("flight-client", f"{host}:{port}")
     extra = None
     with _POOL_LOCK:
         raced = _POOL.get(key)
@@ -85,6 +91,8 @@ def _client_for(host: str, port: int) -> paflight.FlightClient:
             client, extra = raced, client
         else:
             _POOL[key] = client
+            _POOL_TOKENS[key], tok = tok, None
+    reswitness.release(tok)  # store-race loser: closed right below
     if extra is not None:
         with contextlib.suppress(Exception):
             extra.close()
@@ -98,17 +106,29 @@ def _evict(host: str, port: int, client: paflight.FlightClient) -> None:
     mid-do_get on the shared channel, and closing under them would turn
     their healthy streams into spurious failures — the evicted client is
     closed by GC once the last user drops it."""
+    from ballista_tpu.analysis import reswitness
+
     key = (host, port)
     with _POOL_LOCK:
         if _POOL.get(key) is client:
             del _POOL[key]
+            # ownership deliberately moves to GC (in-flight streams may
+            # still be using the channel) — the eviction IS the release
+            # decision the witness records
+            reswitness.release(_POOL_TOKENS.pop(key, None))
 
 
 def close_pool() -> None:
     """Close every cached connection (tests / process shutdown)."""
+    from ballista_tpu.analysis import reswitness
+
     with _POOL_LOCK:
         clients = list(_POOL.values())
         _POOL.clear()
+        tokens = list(_POOL_TOKENS.values())
+        _POOL_TOKENS.clear()
+    for t in tokens:
+        reswitness.release(t)
     for c in clients:
         with contextlib.suppress(Exception):
             c.close()

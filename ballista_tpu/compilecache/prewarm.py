@@ -43,9 +43,41 @@ class PrewarmHandle:
     and safe from any thread."""
 
     def __init__(self, pool=None, futures=(), n_signatures: int = 0):
+        from ballista_tpu.analysis import reswitness
+
         self._pool = pool
         self._futures = list(futures)
         self.n_signatures = n_signatures
+        self._witness_token = (
+            reswitness.acquire("thread-pool", "compile-prewarm")
+            if pool is not None
+            else None
+        )
+        # a TpuContext-started background prewarm is never stopped or
+        # joined — the pool drains on its own (start_prewarm calls
+        # shutdown(wait=False) right after the submits) — so the witness
+        # entry must also self-release when the LAST future completes,
+        # or assert_drained() would report a false leak for a pool whose
+        # workers exited long ago. release() is idempotent: racing
+        # _shutdown() is harmless.
+        self._pending = len(self._futures)
+        self._pending_lock = threading.Lock()
+        if pool is not None and not self._futures:
+            self._release_witness()
+        for f in self._futures:
+            f.add_done_callback(self._one_done)
+
+    def _release_witness(self) -> None:
+        from ballista_tpu.analysis import reswitness
+
+        reswitness.release(self._witness_token)
+
+    def _one_done(self, _f) -> None:
+        with self._pending_lock:
+            self._pending -= 1
+            drained = self._pending == 0
+        if drained:
+            self._release_witness()
 
     def join(self, timeout: float | None = None) -> bool:
         """Wait for completion; True when every signature finished."""
@@ -63,8 +95,11 @@ class PrewarmHandle:
                 return False
             except cf.CancelledError:
                 pass
-            except Exception:  # noqa: BLE001 — logged by the worker
-                pass
+            except Exception as e:  # noqa: BLE001
+                # _compile_one already logged the compile failure; anything
+                # ELSE escaping a worker must not vanish (lifelint
+                # swallowed-error)
+                log.debug("prewarm join: worker raised %s", e)
         self._shutdown(wait=True)
         return True
 
@@ -93,8 +128,8 @@ class PrewarmHandle:
                 return
             except cf.CancelledError:
                 pass
-            except Exception:  # noqa: BLE001 — logged by the worker
-                pass
+            except Exception as e:  # noqa: BLE001
+                log.debug("prewarm stop: worker raised %s", e)
         self._shutdown(wait=True)
 
     def _cancel_queued(self) -> None:
@@ -112,6 +147,7 @@ class PrewarmHandle:
         pool, self._pool = self._pool, None
         if pool is not None:
             pool.shutdown(wait=wait, cancel_futures=not wait)
+        self._release_witness()
 
 
 _NOOP = PrewarmHandle()
@@ -166,7 +202,17 @@ def start_prewarm(
             if fingerprint in _STARTED:
                 return _NOOP
             _STARTED.add(fingerprint)
-    sigs = registry.enumerate_prewarm(buckets)
+    try:
+        sigs = registry.enumerate_prewarm(buckets)
+    except BaseException:
+        # roll the latch back: a failed enumeration (bad bucket spec, a
+        # registry bug) must not permanently disable prewarm for this
+        # bucket set in this process (the latch leaked "started" state
+        # for work that never started)
+        if once:
+            with _LATCH_LOCK:
+                _STARTED.discard(fingerprint)
+        raise
     log.info(
         "prewarm(%s): %d signatures over buckets %s",
         mode, len(sigs), list(buckets),

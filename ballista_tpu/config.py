@@ -101,6 +101,148 @@ BALLISTA_INTERNAL_PREFIX = "ballista.internal."
 BALLISTA_INTERNAL_TASK_ATTEMPT = "ballista.internal.task_attempt"
 
 
+@dataclasses.dataclass(frozen=True)
+class EnvEntry:
+    """One declared ``BALLISTA_*`` environment variable. Process-scoped
+    knobs (daemons have no session config at start; debug witnesses must
+    not ride query settings) live HERE; everything query-scoped is a
+    ``ConfigEntry`` above. The lifelint config-registry analyzer
+    (analysis/configlint.py) proves every env read site in the tree
+    resolves to exactly one of these entries, and docs/config.md is
+    generated from both tables. A trailing ``*`` declares a prefix family
+    (per-flag daemon overrides)."""
+
+    name: str
+    kind: str  # value shape shown in docs ("0|1", "path|off", ...)
+    default: str
+    description: str
+    doc: str  # owning doc page
+
+
+ENV_REGISTRY: tuple[EnvEntry, ...] = (
+    EnvEntry(
+        "BALLISTA_FAULTS", "JSON list", "",
+        "Deterministic fault-injection rules installed at import "
+        "(testing/faults.py); chaos tests set it in SUBPROCESS envs only",
+        "docs/fault_tolerance.md",
+    ),
+    EnvEntry(
+        "BALLISTA_FAULTS_SEED", "int", "0",
+        "Seed for probabilistic fault rules (p < 1)",
+        "docs/fault_tolerance.md",
+    ),
+    EnvEntry(
+        "BALLISTA_LOCK_WITNESS", "0|1", "0",
+        "Runtime lock-order witness: control-plane locks record per-"
+        "thread acquisition order and flag inversions live "
+        "(analysis/witness.py)",
+        "docs/analysis.md",
+    ),
+    EnvEntry(
+        "BALLISTA_RESOURCE_WITNESS", "0|1", "0",
+        "Runtime resource witness: channels/pools/files/spill sets "
+        "register on acquire and must drain to zero at shutdown "
+        "(analysis/reswitness.py)",
+        "docs/analysis.md",
+    ),
+    EnvEntry(
+        "BALLISTA_TPU_JAX_CACHE", "path|off", "~/.cache/ballista_tpu_jax",
+        "Persistent XLA compilation cache directory; 'off' disables the "
+        "cache machinery entirely",
+        "docs/compile_cache.md",
+    ),
+    EnvEntry(
+        "BALLISTA_TPU_HINT_CACHE", "path|off", "(rides the XLA cache dir)",
+        "Persisted plan-shape hints (join strategies, learned "
+        "capacities) location override",
+        "docs/compile_cache.md",
+    ),
+    EnvEntry(
+        "BALLISTA_TPU_PREWARM", "off|on|background", "off",
+        "AOT kernel prewarm mode for executor processes (no session "
+        "config at start); an explicit --prewarm flag wins",
+        "docs/compile_cache.md",
+    ),
+    EnvEntry(
+        "BALLISTA_TPU_PREWARM_BUCKETS", "csv ints", "",
+        "Bounds the prewarm ladder enumeration (tests / constrained "
+        "hosts)",
+        "docs/compile_cache.md",
+    ),
+    EnvEntry(
+        "BALLISTA_TPU_CAPACITY_BUCKETS", "ladder spec", "",
+        "Capacity-bucket ladder for server prewarm on non-default "
+        "deployments (session config arrives only with the first task)",
+        "docs/compile_cache.md",
+    ),
+    EnvEntry(
+        "BALLISTA_TPU_NO_FUSE", "set|unset", "",
+        "Debug: disable Filter/Projection chain fusion (per-operator "
+        "dispatch, for isolating a fused-kernel miscompare)",
+        "docs/analysis.md",
+    ),
+    EnvEntry(
+        "BALLISTA_PLUGIN_DIR", "path", "",
+        "UDF plugin directory consulted alongside ballista.plugin_dir",
+        "docs/client-api.md",
+    ),
+    EnvEntry(
+        "BALLISTA_SCHEDULER_*", "per-flag", "",
+        "Scheduler daemon CLI-flag defaults "
+        "(BALLISTA_SCHEDULER_<FLAG>=v; scheduler/__main__.py)",
+        "docs/deployment.md",
+    ),
+    EnvEntry(
+        "BALLISTA_EXECUTOR_*", "per-flag", "",
+        "Executor daemon CLI-flag defaults (executor/__main__.py)",
+        "docs/deployment.md",
+    ),
+    EnvEntry(
+        "BALLISTA_TEST_TIME_LIMIT_S", "seconds", "300",
+        "Tier-1 per-test wall-clock guard (tests/conftest.py); 0 "
+        "disables",
+        "docs/analysis.md",
+    ),
+)
+
+
+def env_entry_for(name: str) -> EnvEntry | None:
+    """The registry entry covering env var ``name`` (exact or prefix
+    family), or None — the runtime side of the configlint closure."""
+    for e in ENV_REGISTRY:
+        if e.name.endswith("*"):
+            if name.startswith(e.name[:-1]):
+                return e
+        elif e.name == name:
+            return e
+    return None
+
+
+_ENV_WARNED = False
+
+
+def warn_unknown_env() -> list[str]:
+    """Warn (once per process) about ``BALLISTA_*`` environment variables
+    no registry entry covers — a typo'd knob silently doing nothing is
+    the env-var analogue of the unknown-config-key ConfigError. Returns
+    the offending names (for tests)."""
+    import logging
+    import os
+
+    global _ENV_WARNED
+    unknown = sorted(
+        k for k in os.environ
+        if k.startswith("BALLISTA_") and env_entry_for(k) is None
+    )
+    if unknown and not _ENV_WARNED:
+        logging.getLogger(__name__).warning(
+            "unrecognized BALLISTA_* environment variables (typo? see "
+            "docs/config.md): %s", ", ".join(unknown),
+        )
+    _ENV_WARNED = True
+    return unknown
+
+
 class TaskSchedulingPolicy(Enum):
     """Pull vs push task dispatch (ref config.rs:264-281)."""
 

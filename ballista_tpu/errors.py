@@ -156,8 +156,6 @@ def parse_shuffle_fetch_error(error: str):
 # identical error, so the scheduler short-circuits straight to JobFailed
 # with zero retries. Keyed by exception TYPE NAME because task errors
 # cross the wire as "TypeName: message" strings (executor.as_task_status).
-# ExecutionError/CapacityError/ShuffleFetchError/grpc failures stay
-# retryable: another attempt (possibly on another executor) can succeed.
 NON_RETRYABLE_ERROR_TYPES = frozenset(
     {
         "PlanVerificationError",
@@ -170,15 +168,58 @@ NON_RETRYABLE_ERROR_TYPES = frozenset(
         "NotImplementedError",
         "TypeError",
         "AttributeError",
+        "ValueError",
+        "KeyError",
+        "AssertionError",
     }
 )
+
+# Errors where another attempt (possibly on another executor, possibly
+# after lost-shuffle recompute) can genuinely succeed. This list exists
+# for the lifelint error-taxonomy closure (analysis/lifelint.py): every
+# exception type RAISED in the task-boundary surfaces must appear in
+# exactly one of the two lists, so "retryable" is always a decision and
+# never a fall-through. ``error_is_retryable`` still defaults UNKNOWN
+# wire strings (third-party types surfacing through a catch-all) to
+# retryable — a wasted bounded retry is cheaper than failing a
+# recoverable job — but nothing this codebase raises may rely on that
+# default.
+RETRYABLE_ERROR_TYPES = frozenset(
+    {
+        # framework errors where the environment, not the plan, failed
+        "BallistaError",
+        "ExecutionError",
+        "CapacityError",
+        "ShuffleFetchError",
+        "SpeculationMiss",
+        "GrpcError",
+        "IoError",
+        # transport-layer types the data plane raises/absorbs (pyarrow
+        # Flight + grpc); surviving ones classify like any wire string
+        "FlightError",
+        "FlightUnavailableError",
+        "FlightTimedOutError",
+        "FlightCancelledError",
+        "FlightServerError",
+        "FlightInternalError",
+        "RpcError",
+        # deterministic chaos faults (testing/faults.py): injected
+        # crashes/fetch errors simulate retryable infrastructure failure
+        "InjectedFault",
+        "InjectedFetchError",
+    }
+)
+
+_OVERLAP = NON_RETRYABLE_ERROR_TYPES & RETRYABLE_ERROR_TYPES
+assert not _OVERLAP, f"error taxonomy lists overlap: {sorted(_OVERLAP)}"
 
 
 def error_is_retryable(error: str) -> bool:
     """Classify a wire-format task error ("TypeName: message..."): False
     for the deterministic taxonomy above, True otherwise (unknown errors
     default to retryable — a wasted bounded retry is cheaper than failing
-    a recoverable job)."""
+    a recoverable job; the lifelint closure keeps first-party raises out
+    of that default)."""
     head = (error or "").lstrip()
     type_name = head.split(":", 1)[0].strip()
     return type_name not in NON_RETRYABLE_ERROR_TYPES

@@ -637,6 +637,9 @@ class HashAggregateExec(ExecutionPlan):
     # Max per-batch partial states held live before an incremental fold
     # (see _execute_partial): bounds HBM at wide cardinalities.
     _FOLD_WIDTH = 4
+    # backpressure async-copy support latch: flipped False on the first
+    # platform refusal so later folds skip the raise/except round trip
+    _bp_async_ok = True
     # Disjoint-path bounds are settled once per this many batches: one
     # blocking fetch is a full host round trip (~100ms tunnelled), while
     # the queued states bound in-flight HBM to ~a chunk of batch pipelines.
@@ -1127,10 +1130,15 @@ class HashAggregateExec(ExecutionPlan):
                     import numpy as _np
 
                     flag = partials[0].valid[:1]
-                    try:
-                        flag.copy_to_host_async()
-                    except Exception:  # platform without async copies
-                        pass
+                    if self._bp_async_ok:
+                        try:
+                            flag.copy_to_host_async()
+                        except Exception:
+                            # platform without async copies: latch it so
+                            # later folds stop raising per batch — the
+                            # asarray below still syncs, just without
+                            # copy/dispatch overlap
+                            self._bp_async_ok = False
                     if bp_prev is not None:
                         _np.asarray(bp_prev)
                     bp_prev = flag

@@ -50,7 +50,10 @@ def prefetch_slices(load, items, depth: int, metrics=None):
     from collections import deque
     from concurrent.futures import ThreadPoolExecutor
 
+    from ballista_tpu.analysis import reswitness
+
     ex = ThreadPoolExecutor(max_workers=1, thread_name_prefix="scan-prefetch")
+    pool_tok = reswitness.acquire("thread-pool", "scan-prefetch")
     try:
         pending: deque = deque()
         idx = 0
@@ -74,6 +77,7 @@ def prefetch_slices(load, items, depth: int, metrics=None):
         # an abandoned consumer (LIMIT) must not leave the worker reading
         # a file the caller is about to close
         ex.shutdown(wait=True, cancel_futures=True)
+        reswitness.release(pool_tok)
 
 
 def fusable_chain(plan: ExecutionPlan):

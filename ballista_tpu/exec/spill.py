@@ -62,6 +62,8 @@ class SpillManager:
     """All spill files of one task attempt, under one directory."""
 
     def __init__(self, base_dir: str | None, budget_bytes: int) -> None:
+        from ballista_tpu.analysis import reswitness
+
         if base_dir is None:
             base_dir = SPILL_TMP_ROOT
         os.makedirs(base_dir, exist_ok=True)
@@ -70,6 +72,7 @@ class SpillManager:
         self.budget_bytes = budget_bytes
         self.total_bytes = 0
         self._sets: list[SpillSet] = []
+        self._witness_token = reswitness.acquire("spill-manager", self.dir)
 
     def new_set(self, tag: str, buckets: int) -> "SpillSet":
         s = SpillSet(self, os.path.join(self.dir, tag), buckets)
@@ -86,10 +89,14 @@ class SpillManager:
             )
 
     def close(self) -> None:
+        from ballista_tpu.analysis import reswitness
+
         for s in self._sets:
             s.close()
         self._sets.clear()
         shutil.rmtree(self.dir, ignore_errors=True)
+        reswitness.release(self._witness_token)
+        self._witness_token = None
 
 
 class SpillSet:

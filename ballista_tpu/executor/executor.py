@@ -62,6 +62,7 @@ class Executor:
         self._locations_channel = None
         self._locations_stub = None
         self._locations_closed = False
+        self._locations_token = None  # reswitness entry for the channel
         # re-verify decoded stage plans before running them (catches serde
         # drift between scheduler and executor builds). StandaloneCluster
         # turns this off: in-proc, the scheduler just verified the same
@@ -91,8 +92,13 @@ class Executor:
             stub = self._locations_stub
         if stub is not None or not self.scheduler_addr:
             return stub
+        from ballista_tpu.analysis import reswitness
+
         ch = grpc.insecure_channel(self.scheduler_addr)
         stub = scheduler_stub(ch)
+        tok = reswitness.acquire(
+            "grpc-channel", f"eager-locations->{self.scheduler_addr}"
+        )
         extra = None
         with self._locations_lock:
             if self._locations_closed:
@@ -104,6 +110,8 @@ class Executor:
             else:
                 self._locations_channel = ch
                 self._locations_stub = stub
+                self._locations_token, tok = tok, None
+        reswitness.release(tok)  # race loser / closed: channel dies below
         if extra is not None:
             try:
                 extra.close()
@@ -150,11 +158,16 @@ class Executor:
         hygiene tests count threads). Latches CLOSED: an in-flight task
         polling after this must get None, not re-dial a channel nobody
         will close."""
+        from ballista_tpu.analysis import reswitness
+
         with self._locations_lock:
             ch = self._locations_channel
+            tok = self._locations_token
             self._locations_channel = None
             self._locations_stub = None
+            self._locations_token = None
             self._locations_closed = True
+        reswitness.release(tok)
         if ch is not None:
             try:
                 ch.close()
@@ -353,7 +366,12 @@ class PollLoop:
         )
 
     def run(self) -> None:
+        from ballista_tpu.analysis import reswitness
+
         channel = grpc.insecure_channel(self.scheduler_addr)
+        tok = reswitness.acquire(
+            "grpc-channel", f"poll-loop->{self.scheduler_addr}"
+        )
         stub = scheduler_stub(channel)
         try:
             self._poll(stub)
@@ -361,6 +379,7 @@ class PollLoop:
             # the channel owns sockets and callback threads; a stopped
             # loop that abandons it leaks them across start/stop cycles
             channel.close()
+            reswitness.release(tok)
 
     def _poll(self, stub) -> None:
         while not self._stop.is_set():
@@ -438,7 +457,12 @@ class PollLoop:
                 )
             )
 
-        threading.Thread(target=work, daemon=True, name="task-runner").start()
+        # fire-and-forget by design: concurrency is bounded by the task
+        # slot semaphore and completion is observed through the status
+        # queue, not a join (ref execution_loop.rs thread spawn)
+        threading.Thread(  # lifelint: transfer=semaphore-bounded
+            target=work, daemon=True, name="task-runner"
+        ).start()
 
 
 def new_executor_id() -> str:

@@ -81,6 +81,7 @@ class ExecutorServer:
         self._grpc_server: grpc.Server | None = None
         self.grpc_port: int = 0
         self._channel: grpc.Channel | None = None
+        self._channel_token = None
         self._sched = None
 
     # -- gRPC service (ExecutorGrpc) -----------------------------------------
@@ -115,12 +116,26 @@ class ExecutorServer:
         gs.start()
         self._grpc_server = gs
 
-        self._channel = grpc.insecure_channel(self.scheduler_addr)
-        self._sched = scheduler_stub(self._channel)
-        self._sched.RegisterExecutor(
-            pb.RegisterExecutorParams(metadata=self._metadata()),
-            timeout=RPC_TIMEOUT_S,
-        )
+        try:
+            from ballista_tpu.analysis import reswitness
+
+            self._channel = grpc.insecure_channel(self.scheduler_addr)
+            self._channel_token = reswitness.acquire(
+                "grpc-channel", f"executor-server->{self.scheduler_addr}"
+            )
+            self._sched = scheduler_stub(self._channel)
+            self._sched.RegisterExecutor(
+                pb.RegisterExecutorParams(metadata=self._metadata()),
+                timeout=RPC_TIMEOUT_S,
+            )
+        except BaseException:
+            # partial-startup teardown (lifelint/reswitness): a failed
+            # registration (scheduler not up yet, bad address) used to
+            # leave a RUNNING gRPC server, an open channel, and a live
+            # prewarm pool behind a raised startup() — nobody calls
+            # stop() on an instance that never started
+            self.stop()
+            raise
 
         hb = threading.Thread(
             target=self._heartbeat_loop, daemon=True, name="heartbeater"
@@ -263,4 +278,8 @@ class ExecutorServer:
                 "leaving the scheduler channel open for them", stragglers,
             )
         elif self._channel is not None:
+            from ballista_tpu.analysis import reswitness
+
             self._channel.close()
+            reswitness.release(getattr(self, "_channel_token", None))
+            self._channel_token = None

@@ -25,6 +25,7 @@ from __future__ import annotations
 import os
 import threading
 
+import pyarrow as pa
 import pyarrow.flight as paflight
 import pyarrow.ipc as paipc
 
@@ -63,11 +64,6 @@ class BallistaFlightService(paflight.FlightServerBase):
             )
         fp = action.fetch_partition
         path = self._contained_path(fp.path)
-        # buffered (not mmap) reads: the batches are serialized out to the
-        # wire immediately, so zero-copy buys nothing here, while a mapped
-        # 256MB+ file's touched pages would sit in this process's RSS
-        # (readers take the mmap fast path on LOCAL files instead)
-        reader = paipc.open_file(path)
 
         from ballista_tpu.config import BALLISTA_SHUFFLE_COMPRESSION
 
@@ -89,24 +85,65 @@ class BallistaFlightService(paflight.FlightServerBase):
 
         inj = faults.active()
 
+        # Opened LAST — everything above can raise, and an open file has no
+        # owner until the GeneratorStream below takes it. The fd is owned
+        # EXPLICITLY (pa.OSFile): pyarrow's RecordBatchFileReader has no
+        # close() and never closes a source it was handed, so the previous
+        # open_file(path) held an internal fd per request until GC
+        # (lifelint leaked-resource — fd pressure under shuffle fan-in).
+        # Buffered (not mmap) reads: the batches are serialized out to the
+        # wire immediately, so zero-copy buys nothing here, while a mapped
+        # 256MB+ file's touched pages would sit in this process's RSS
+        # (readers take the mmap fast path on LOCAL files instead)
+        from ballista_tpu.analysis import reswitness
+
+        source = pa.OSFile(path, "rb")  # lifelint: transfer=stream-generator
+        src_tok = reswitness.acquire("served-file", path)
+        try:
+            reader = paipc.open_file(source)
+            schema = reader.schema
+        except BaseException:
+            source.close()
+            reswitness.release(src_tok)
+            raise
+
         # Stream the file batch-at-a-time (ref flight_service.rs:203-228
         # sends batches through a channel) — read_all() here held the whole
-        # shuffle partition in server memory, an OOM at SF=100 widths.
-        def batches(r=reader):
-            for i in range(r.num_record_batches):
-                if inj is not None:
-                    # producer-kill-mid-stream chaos (docs/shuffle.md):
-                    # the serving executor "dies" after i batches already
-                    # flowed to the consumer — the eager-mode recovery
-                    # shape where downstream streamed part of an output
-                    # that then has to be recomputed
-                    inj.on_serve_batch(
-                        fp.job_id, fp.stage_id, fp.partition_id, i,
-                        path=path,
-                    )
-                yield r.get_batch(i)
+        # shuffle partition in server memory, an OOM at SF=100 widths. The
+        # finally closes the fd DETERMINISTICALLY on exhaustion, on a
+        # mid-stream fault, and on client cancellation (Flight closes the
+        # generator) instead of leaving each request's fd to GC.
+        def batches(r=reader, src=source, tok=src_tok):
+            try:
+                # priming yield (consumed below, never streamed): a
+                # generator that was never STARTED does not run its
+                # finally on close()/GC, so a client cancelling before
+                # the first batch would leak the fd again — entering the
+                # try here arms the cleanup unconditionally
+                yield None
+                for i in range(r.num_record_batches):
+                    if inj is not None:
+                        # producer-kill-mid-stream chaos (docs/shuffle.md):
+                        # the serving executor "dies" after i batches were
+                        # already consumed — the eager-mode recovery shape
+                        # where downstream streamed part of an output that
+                        # then has to be recomputed
+                        inj.on_serve_batch(
+                            fp.job_id, fp.stage_id, fp.partition_id, i,
+                            path=path,
+                        )
+                    yield r.get_batch(i)
+            finally:
+                src.close()
+                reswitness.release(tok)
 
-        return paflight.GeneratorStream(reader.schema, batches(), options=options)
+        gen = batches()
+        next(gen)  # enter the try: cleanup now runs on any outcome
+        try:
+            return paflight.GeneratorStream(schema, gen, options=options)
+        except BaseException:
+            gen.close()
+            raise
 
     # Remaining verbs deliberately unimplemented (ref :119-184).
 

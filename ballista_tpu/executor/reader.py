@@ -31,6 +31,7 @@ partition) so the scheduler can recompute lost map output.
 from __future__ import annotations
 
 import collections
+import contextlib as _contextlib
 import dataclasses
 import os
 import queue as _queue
@@ -61,11 +62,27 @@ BATCH_ROWS = 1 << 17
 _QUEUE_DEPTH = 4
 
 
+@_contextlib.contextmanager
 def _open_local_file(path: str):
     """Arrow IPC reader over a memory map: uncompressed shuffle files are
     then consumed zero-copy (batches alias the page cache instead of being
-    read into fresh host buffers); compressed ones decode per batch."""
-    return paipc.open_file(pa.memory_map(path))
+    read into fresh host buffers); compressed ones decode per batch.
+
+    A context manager that closes the MEMORY MAP itself: pyarrow's
+    ``RecordBatchFileReader`` has no ``close()`` and its ``with`` is a
+    no-op, so the previous ``open_file(memory_map(path))`` left every
+    fetched partition's fd + mapping open until GC (lifelint
+    leaked-resource; on a wide fan-in that is hundreds of live maps whose
+    touched pages all count into RSS — docs/memory.md)."""
+    from ballista_tpu.analysis import reswitness
+
+    src = pa.memory_map(path)
+    tok = reswitness.acquire("mmap", path)
+    try:
+        yield paipc.open_file(src)
+    finally:
+        src.close()
+        reswitness.release(tok)
 
 
 def fetch_partition_table(loc: PartitionLocation) -> pa.Table:
@@ -389,24 +406,35 @@ def _iter_location_batches(
                 return
             got_any = False
             it = fetch_one(loc)
-            while True:
-                with metrics.time("fetch_time"):
-                    rb = next(it, None)
-                if rb is None:
-                    break
-                got_any = True
-                metrics.add("fetched_bytes", rb.nbytes)
-                yield rb
+            try:
+                while True:
+                    with metrics.time("fetch_time"):
+                        rb = next(it, None)
+                    if rb is None:
+                        break
+                    got_any = True
+                    metrics.add("fetched_bytes", rb.nbytes)
+                    yield rb
+            finally:
+                # deterministic cancel of the in-flight Flight read /
+                # local mmap on a consumer that stops early
+                # (GeneratorExit) or a downstream error — parity with
+                # the overlapped path's stop+join, instead of leaving
+                # the fetch generator's cleanup to GC timing
+                it.close()
             if got_any:
                 metrics.add("fetched_batches")
 
     from concurrent.futures import ThreadPoolExecutor
+
+    from ballista_tpu.analysis import reswitness
 
     stop = threading.Event()
     window: collections.deque = collections.deque()
     ex = ThreadPoolExecutor(
         max_workers=concurrency, thread_name_prefix="shuffle-fetch"
     )
+    pool_tok = reswitness.acquire("thread-pool", "shuffle-fetch")
 
     def pump(loc: PartitionLocation, q: _queue.Queue) -> None:
         try:
@@ -419,7 +447,10 @@ def _iter_location_batches(
 
     def start_fetch(loc: PartitionLocation) -> None:
         q: _queue.Queue = _queue.Queue(maxsize=_QUEUE_DEPTH)
-        window.append((loc, q))
+        qtok = reswitness.acquire(
+            "fetch-queue", f"{loc.job_id}/{loc.stage_id}/{loc.partition}"
+        )
+        window.append((loc, q, qtok))
         ex.submit(pump, loc, q)
 
     def top_up() -> None:
@@ -438,7 +469,7 @@ def _iter_location_batches(
                     return
                 start_fetch(loc)
                 top_up()
-            _loc, q = window[0]
+            _loc, q, qtok = window[0]
             got_any = False
             while True:
                 try:
@@ -465,6 +496,7 @@ def _iter_location_batches(
                 yield item
                 top_up()
             window.popleft()
+            reswitness.release(qtok)
             if got_any:
                 metrics.add("fetched_batches")
             top_up()
@@ -474,6 +506,9 @@ def _iter_location_batches(
         # the pool join guarantees no fetch thread outlives the task
         stop.set()
         ex.shutdown(wait=True, cancel_futures=True)
+        reswitness.release(pool_tok)
+        for _loc, _q, qtok in window:  # abandoned mid-flight locations
+            reswitness.release(qtok)
 
 
 class ShuffleReaderExec(ExecutionPlan):

@@ -84,6 +84,9 @@ def main(argv: list[str] | None = None) -> int:
         level=getattr(logging, args.log_level.upper(), logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
+    from ballista_tpu.config import warn_unknown_env
+
+    warn_unknown_env()  # typo'd BALLISTA_* knobs must be loud (config.md)
     # re-log the import-time cache decision now that a handler exists
     import ballista_tpu
 
@@ -138,7 +141,9 @@ def main(argv: list[str] | None = None) -> int:
     stop.wait()
     log.info("shutting down")
     if rest is not None:
-        rest.shutdown()
+        from ballista_tpu.scheduler.rest import stop_rest_server
+
+        stop_rest_server(rest)
     grpc_server.stop(grace=1)
     server.shutdown()
     backend.close()

@@ -129,8 +129,11 @@ class _EtcdLock:
                 except grpc.RpcError:
                     pass  # holder exit cancels the stream
 
-            threading.Thread(target=drain, daemon=True,
-                             name="etcd-lock-keepalive").start()
+            # exits when __exit__ cancels the keepalive stream: the
+            # thread's lifetime IS the stream's
+            threading.Thread(  # lifelint: transfer=stream-bounded
+                target=drain, daemon=True,
+                name="etcd-lock-keepalive").start()
         except grpc.RpcError:
             log.warning("etcd lease keepalive unavailable; lock relies on "
                         "TTL=%ss outliving the critical section",
@@ -275,8 +278,11 @@ class EtcdBackend(StateBackendClient):
             created.set()  # unblock the creator on early failure too
             w.stop()
 
-        threading.Thread(target=pump, daemon=True,
-                         name=f"etcd-watch-{prefix}").start()
+        # exits when w.stop()/close() cancels the watch stream: the
+        # thread's lifetime IS the stream's
+        threading.Thread(  # lifelint: transfer=stream-bounded
+            target=pump, daemon=True,
+            name=f"etcd-watch-{prefix}").start()
         # Hand the watch out only after the server acknowledged it
         # (created=true): a put() racing watch() must not fall into the
         # gap before registration.

@@ -319,5 +319,20 @@ def start_rest_server(server, host: str = "0.0.0.0", port: int = 0):
 
     httpd = ThreadingHTTPServer((host, port), Handler)
     t = threading.Thread(target=httpd.serve_forever, daemon=True, name="rest")
+    # the serve thread rides on the httpd so stop_rest_server can join it
+    # (a bare .shutdown() stopped serve_forever but left the LISTENING
+    # SOCKET open and the thread unjoined — lifelint leaked-resource)
+    httpd._serve_thread = t
     t.start()
     return httpd, httpd.server_address[1]
+
+
+def stop_rest_server(httpd) -> None:
+    """Full REST teardown: stop serve_forever, join the serve thread, and
+    CLOSE the listening socket (``shutdown()`` alone leaks it until
+    process exit — repeated start/stop cycles would pile up bound fds)."""
+    httpd.shutdown()
+    t = getattr(httpd, "_serve_thread", None)
+    if t is not None:
+        t.join(timeout=5)
+    httpd.server_close()

@@ -82,6 +82,12 @@ class StandaloneCluster:
     ) -> "StandaloneCluster":
         tmp = tempfile.TemporaryDirectory(prefix="ballista-standalone-")
 
+        # unknown-key warning for env config, mirroring BallistaConfig's
+        # ConfigError for session keys (docs/config.md)
+        from ballista_tpu.config import warn_unknown_env
+
+        warn_unknown_env()
+
         scheduler = SchedulerServer(
             provider=provider,
             config=config,

@@ -25,6 +25,13 @@ import pytest
 for _k in ("BALLISTA_FAULTS", "BALLISTA_FAULTS_SEED"):
     os.environ.pop(_k, None)
 
+# Witness hygiene: the lock-order and resource witnesses are debug modes
+# that chaos/hygiene tests enable in SUBPROCESS envs; leaked into the
+# runner they would instrument every test's locks/channels and make
+# tier-1 timing (and witness assertions) nondeterministic.
+for _k in ("BALLISTA_LOCK_WITNESS", "BALLISTA_RESOURCE_WITNESS"):
+    os.environ.pop(_k, None)
+
 # Hermetic plan-hint persistence: without this, every in-test TpuContext/
 # Executor would read AND write the developer's real hint file
 # (compilecache/hints.py rides the XLA cache dir), making test behavior

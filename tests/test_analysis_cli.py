@@ -1,0 +1,107 @@
+"""Combined-gate CLI contract (ISSUE 8 satellite): ``python -m
+ballista_tpu.analysis`` aggregates all eight analyzers into ONE exit
+code — any analyzer failing alone must fail the run — and ``--skip`` /
+``--only`` select analyzers without disturbing the exit-code semantics.
+
+The matrix monkeypatches the per-analyzer runners (each real analyzer
+has its own tier-1 suite); ``--only lifelint`` and the three new PR 8
+analyzers also run FOR REAL here (they are cheap AST/descriptor walks).
+"""
+
+import pytest
+
+import ballista_tpu.analysis.__main__ as amain
+
+
+def _fake_runners(monkeypatch, failing: str | None):
+    for name in amain.ANALYZERS:
+        attr = "run_" + name.replace("-", "_")
+        if name == "serde-audit":
+            attr = "run_serde_audit"
+
+        def make(n=name):
+            def run(*a, **k):
+                if n == failing:
+                    return False, f"{n} seeded failure"
+                return True, f"{n} ok"
+            return run
+
+        monkeypatch.setattr(amain, attr, make(), raising=True)
+    # planlint/compile-vocab take a queries arg through lambdas
+    monkeypatch.setattr(
+        amain, "run_planlint",
+        lambda queries=None: (
+            (False, "planlint seeded failure")
+            if failing == "planlint" else (True, "planlint ok")
+        ),
+    )
+    monkeypatch.setattr(
+        amain, "run_compile_vocab",
+        lambda queries=None: (
+            (False, "compile-vocab seeded failure")
+            if failing == "compile-vocab" else (True, "compile-vocab ok")
+        ),
+    )
+
+
+def test_all_green_exits_zero(monkeypatch):
+    _fake_runners(monkeypatch, failing=None)
+    lines = []
+    assert amain.run_all(out=lines.append) == 0
+    assert len([ln for ln in lines if ": OK" in ln]) == len(
+        amain.ANALYZERS
+    )
+
+
+@pytest.mark.parametrize("victim", amain.ANALYZERS)
+def test_each_analyzer_failing_alone_fails_the_run(monkeypatch, victim):
+    _fake_runners(monkeypatch, failing=victim)
+    lines = []
+    assert amain.run_all(out=lines.append) == 1
+    joined = "\n".join(lines)
+    assert f"{victim}: FAIL" in joined
+    assert joined.count(": FAIL") == 1
+    assert f"FAILED: {victim}" in joined
+
+
+def test_skip_and_only_select_analyzers(monkeypatch):
+    _fake_runners(monkeypatch, failing="racelint")
+    lines = []
+    # skipping the failing analyzer turns the run green
+    assert amain.run_all(skip=("racelint",), out=lines.append) == 0
+    assert "racelint: SKIPPED" in "\n".join(lines)
+    lines = []
+    # --only an unrelated analyzer never runs the failing one
+    assert amain.run_all(only=("lifelint",), out=lines.append) == 0
+    joined = "\n".join(lines)
+    assert "lifelint: OK" in joined
+    assert "racelint: SKIPPED" in joined
+
+
+def test_analyzer_crash_is_a_fail(monkeypatch):
+    _fake_runners(monkeypatch, failing=None)
+
+    def boom():
+        raise RuntimeError("analyzer blew up")
+
+    monkeypatch.setattr(amain, "run_lifelint", boom)
+    lines = []
+    assert amain.run_all(only=("lifelint",), out=lines.append) == 1
+    assert "analyzer crashed" in "\n".join(lines)
+
+
+def test_only_lifelint_runs_for_real():
+    lines = []
+    assert amain.run_all(only=("lifelint",), out=lines.append) == 0
+    line = next(ln for ln in lines if ln.startswith("lifelint:"))
+    assert "OK" in line and "0 findings" in line
+
+
+def test_new_pr8_analyzers_run_for_real():
+    lines = []
+    assert amain.run_all(
+        only=("proto-drift", "config-registry"), out=lines.append
+    ) == 0
+    joined = "\n".join(lines)
+    assert "proto-drift: OK" in joined and "in sync" in joined
+    assert "config-registry: OK" in joined

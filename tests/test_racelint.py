@@ -459,7 +459,8 @@ def test_combined_analysis_gate_is_clean():
     rc = run_all(queries=[1, 3, 6], out=lines.append)
     assert rc == 0, "\n".join(lines)
     for name in (
-        "planlint", "serde-audit", "jaxlint", "racelint", "compile-vocab"
+        "planlint", "serde-audit", "jaxlint", "racelint", "compile-vocab",
+        "lifelint", "proto-drift", "config-registry",
     ):
         assert any(ln.startswith(f"{name}: OK") for ln in lines), lines
 

@@ -1,0 +1,145 @@
+"""Resource witness under chaos (ISSUE 8 acceptance): a two-executor
+cluster runs a TPC-H join with injected fetch faults and a mid-query
+executor kill (``BALLISTA_RESOURCE_WITNESS=1`` in the subprocess env).
+Lost-shuffle recovery exercises every tracked acquisition path —
+channels redialed, fetch pools torn down mid-stream by ShuffleFetchError,
+mmaps/fds on abandoned streams, retried tasks' spill/queue lifecycles —
+and at the end the tracker must report ZERO live resources: kills and
+error paths may not leak what a clean run would have released.
+
+Marked ``chaos``: fault rules + the witness env are enabled in the
+SUBPROCESS only; conftest keeps the pytest process inert.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from tests.conftest import CPU_MESH_ENV
+
+SCRIPT = r"""
+import pathlib
+import threading
+import time
+
+from ballista_tpu.analysis import reswitness
+from ballista_tpu.client.context import BallistaContext
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.testing import faults
+from ballista_tpu.tpch import gen_all
+
+assert reswitness.enabled(), "BALLISTA_RESOURCE_WITNESS must reach here"
+
+faults.install(
+    [{"point": "fetch_error", "partition": 0, "attempt": [0, 1],
+      "max_fires": 2},
+     # stretch the shuffle phase so the mid-query kill window is wide
+     {"point": "fetch_slow", "delay_s": 0.05}],
+    seed=7,
+)
+
+cfg = (
+    BallistaConfig()
+    .with_setting("ballista.tpu.fetch_backoff_ms", "10")
+    .with_setting("ballista.shuffle.partitions", "2")
+    # force real shuffle stages (see test_witness_chaos.py): no shuffle
+    # output to lose means no recovery-path resource churn to witness
+    .with_setting("ballista.tpu.collective_shuffle", "false")
+)
+ctx = BallistaContext.standalone(
+    cfg, n_executors=2, executor_timeout_s=2.0, expiry_check_interval_s=0.5
+)
+cluster = ctx._standalone_cluster
+sched = cluster.scheduler
+for name, t in gen_all(scale=0.01).items():
+    ctx.register_table(name, t)
+
+sql = pathlib.Path("benchmarks/queries/q3.sql").read_text()
+
+
+def attempt_kill_mid_query():
+    result = {}
+
+    def drive():
+        result["q3"] = ctx.sql(sql).collect()
+
+    t3 = threading.Thread(target=drive)
+    t3.start()
+    victim_id = None
+    deadline = time.time() + 120
+    while time.time() < deadline and victim_id is None:
+        for (job_id, stage_id), stage in list(
+            sched.stage_manager._stages.items()
+        ):
+            for task in stage.tasks:
+                if task.state.value == "completed" and task.executor_id:
+                    victim_id = task.executor_id
+                    break
+            if victim_id:
+                break
+        time.sleep(0.005)
+    job = list(sched.jobs.values())[-1]
+    if victim_id is None or job.status != "running":
+        t3.join(timeout=300)
+        return None  # query outran the kill window — retry
+    victim_idx = next(
+        i for i, h in enumerate(cluster.executors)
+        if h.executor.executor_id == victim_id
+    )
+    cluster.kill_executor(victim_idx, lose_shuffle=True)
+    cluster.add_executor()
+    t3.join(timeout=300)
+    assert not t3.is_alive(), "q3 wedged after executor kill"
+    assert result["q3"].num_rows > 0, "q3 returned no rows under chaos"
+    assert job.status == "completed", (job.status, job.error)
+    return job
+
+
+job = None
+for _round in range(3):
+    job = attempt_kill_mid_query()
+    if job is not None:
+        break
+assert job is not None, "kill never landed mid-query in 3 rounds"
+assert job.total_retries + job.total_recomputes >= 1, (
+    "kill left no recovery trace"
+)
+ctx.close()
+from ballista_tpu.client.flight import close_pool
+
+close_pool()
+faults.install(None)
+
+# straggler task threads (fire-and-forget runners killed mid-task) may
+# still be unwinding; give their finallys a bounded moment to run
+deadline = time.time() + 30
+while reswitness.live() and time.time() < deadline:
+    time.sleep(0.1)
+
+counts = reswitness.acquired_counts()
+# the witness must have seen real churn across kinds, not a vacuous zero
+assert counts.get("grpc-channel", 0) >= 3, counts
+assert counts.get("fetch-queue", 0) >= 1 or counts.get(
+    "thread-pool", 0
+) >= 1, counts
+reswitness.assert_drained()
+print(f"RESWITNESS-CHAOS-OK {sorted(counts.items())}")
+"""
+
+
+@pytest.mark.chaos
+def test_zero_leaked_resources_under_kill_and_fetch_faults():
+    proc = subprocess.run(
+        [sys.executable, "-c", SCRIPT],
+        env={**CPU_MESH_ENV, "BALLISTA_RESOURCE_WITNESS": "1"},
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "RESWITNESS-CHAOS-OK" in proc.stdout
