@@ -9,9 +9,10 @@ line — the single command CI (and a developer pre-push) needs:
   engine produces.
 - **serde-audit** — structural closure of the proto vocabulary
   (round-trip byte stability or written exemption for every node class).
-- **jaxlint** — JAX/TPU hazard lint over ``ops/`` + ``exec/``.
+- **jaxlint** — JAX/TPU hazard lint over ``ops/`` + ``exec/`` + ``obs/``.
 - **racelint** — lock-discipline + state-machine lint over the
-  concurrent control plane (suppression budget enforced here too).
+  concurrent control plane, including the ``obs/`` trace ring/outbox
+  (suppression budget enforced here too).
 - **compile-vocab** — the closed compiled-kernel vocabulary gate
   (compilecache/registry.py): every jit site in the source report must be
   registered, and every operator class reachable from TPC-H q1-q22

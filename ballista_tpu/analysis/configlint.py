@@ -203,6 +203,8 @@ _PARSER_KINDS = {
     "_parse_shuffle_compression": "none|lz4|zstd",
     "_parse_prewarm": "off|on|background",
     "_parse_capacity_buckets": "ladder spec",
+    "_parse_trace": "off|on|path",
+    "_parse_metrics_collector": "shipping|logging",
 }
 
 

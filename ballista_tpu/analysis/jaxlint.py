@@ -379,7 +379,7 @@ def lint_source(
     return diags, kernels
 
 
-_DEFAULT_TARGETS = ("ops", "exec")
+_DEFAULT_TARGETS = ("ops", "exec", "obs")
 
 
 def _target_files(paths=None) -> list[pathlib.Path]:

@@ -1000,6 +1000,9 @@ _RESOURCE_TARGETS = (
     "compilecache",
     "event_loop.py",
     "standalone.py",
+    # observability plane (PR 10): JSONL export files + the metrics HTTP
+    # server's listening socket
+    "obs",
 )
 
 # error-taxonomy closure: the surfaces whose raises cross the task

@@ -1113,6 +1113,9 @@ _DEFAULT_TARGETS = (
     # overlapped fetch lives in executor/, covered above; the scan
     # prefetch pipeline lives here)
     "exec/pipeline.py",
+    # observability plane (PR 10): the trace ring/outbox is written by
+    # every task thread and drained by the poll/heartbeat loops
+    "obs",
 )
 
 

@@ -35,6 +35,8 @@ HELP = """\
 \\pset format F  set output format (table|csv|tsv|json|ndjson)
 statements end with ';'
 EXPLAIN [VERBOSE] VERIFY <query>;  static plan verification report
+EXPLAIN ANALYZE <query>;  execute + print measured rows/bytes/elapsed
+                          per physical operator (docs/observability.md)
 """
 
 

@@ -150,19 +150,39 @@ def backoff_s(loc: PartitionLocation, attempt: int, backoff_ms: int) -> float:
 
 
 def make_ticket(
-    loc: PartitionLocation, compression: str = ""
+    loc: PartitionLocation,
+    compression: str = "",
+    trace_ctx: tuple[str, str] | None = None,
 ) -> paflight.Ticket:
     """``compression`` (none|lz4|zstd) rides the Action's settings so the
     SERVING executor compresses the Flight stream's IPC buffers — the
     session's ballista.tpu.shuffle_compression applied to bytes on the
-    wire, not just bytes on disk. Empty = server streams uncompressed."""
-    from ballista_tpu.config import BALLISTA_SHUFFLE_COMPRESSION
+    wire, not just bytes on disk. Empty = server streams uncompressed.
+    ``trace_ctx`` (trace_id, parent span id) rides the settings too, so
+    the serving executor's flight_serve span joins the consumer's trace
+    (docs/observability.md)."""
+    from ballista_tpu.config import (
+        BALLISTA_INTERNAL_SPAN_PARENT,
+        BALLISTA_INTERNAL_TRACE_ID,
+        BALLISTA_SHUFFLE_COMPRESSION,
+    )
 
     settings = []
     if compression and compression != "none":
         settings.append(
             pb.KeyValuePair(
                 key=BALLISTA_SHUFFLE_COMPRESSION, value=compression
+            )
+        )
+    if trace_ctx is not None:
+        settings.append(
+            pb.KeyValuePair(
+                key=BALLISTA_INTERNAL_TRACE_ID, value=trace_ctx[0]
+            )
+        )
+        settings.append(
+            pb.KeyValuePair(
+                key=BALLISTA_INTERNAL_SPAN_PARENT, value=trace_ctx[1]
             )
         )
     action = pb.Action(
@@ -266,6 +286,7 @@ def fetch_partition_batches(
     backoff_ms: int | None = None,
     timeout_s: float | None = None,
     compression: str = "",
+    trace_ctx: tuple[str, str] | None = None,
 ):
     """Stream a remote shuffle partition batch-at-a-time (the server side
     is a GeneratorStream over the IPC file) — peak memory is one record
@@ -289,7 +310,7 @@ def fetch_partition_batches(
             _inject_fetch_fault(loc, attempt)
             client = _client_for(loc.host, loc.port)
             reader = client.do_get(
-                make_ticket(loc, compression),
+                make_ticket(loc, compression, trace_ctx=trace_ctx),
                 options=_call_options(timeout_s),
             )
             try:

@@ -55,6 +55,18 @@ def shared_callable(key: tuple, build: Callable[[], Callable]) -> Callable:
         _CACHE[key] = fn
         while len(_CACHE) > _MAX_ENTRIES:
             _CACHE.popitem(last=False)
+    # tracing (docs/observability.md): a shared-callable miss is a fresh
+    # trace+compile — one point event on the ambient task/query span
+    # (no-op when the session doesn't trace); OUTSIDE the lock
+    from ballista_tpu.obs import trace as obs_trace
+
+    obs_trace.event(
+        "trace_cache_miss",
+        attrs={
+            "key": str(key[0]) if isinstance(key, tuple) and key
+            else str(key)
+        },
+    )
     return fn
 
 

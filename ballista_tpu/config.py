@@ -66,6 +66,33 @@ BALLISTA_CAPACITY_BUCKETS = (
     "ballista.tpu.capacity_buckets"  # static-shape bucket ladder
 )
 BALLISTA_PREWARM = "ballista.tpu.prewarm"  # AOT kernel prewarm: off|on|background
+BALLISTA_TRACE = "ballista.tpu.trace"  # distributed tracing: off|on|<jsonl path>
+BALLISTA_METRICS_COLLECTOR = (
+    "ballista.tpu.metrics_collector"  # executor metrics sink: shipping|logging
+)
+
+METRICS_COLLECTORS = ("shipping", "logging")
+
+
+def _parse_metrics_collector(s: str) -> str:
+    v = s.lower()
+    if v not in METRICS_COLLECTORS:
+        raise ValueError(
+            f"not a metrics collector (shipping|logging): {s!r}"
+        )
+    return v
+
+
+def _parse_trace(s: str) -> str:
+    # "off" | "on" (case-insensitive, like every other enum entry) | a
+    # JSONL export path — path-like values are accepted as-is (the tracer
+    # treats unwritable paths as ring-only, never fails a query on it).
+    # Without the lowercasing, "OFF" would read as an export path and
+    # silently turn tracing ON plus create a file named OFF.
+    v = s.strip()
+    if v.lower() in ("off", "on"):
+        return v.lower()
+    return v or "off"
 
 SHUFFLE_COMPRESSION_CODECS = ("none", "lz4", "zstd")
 
@@ -99,6 +126,10 @@ def _parse_shuffle_compression(s: str) -> str:
 # executors strip this prefix before building BallistaConfig.
 BALLISTA_INTERNAL_PREFIX = "ballista.internal."
 BALLISTA_INTERNAL_TASK_ATTEMPT = "ballista.internal.task_attempt"
+# distributed tracing (docs/observability.md): trace id minted at job
+# submission + the parent span id (the stage's span) for the task attempt
+BALLISTA_INTERNAL_TRACE_ID = "ballista.internal.trace_id"
+BALLISTA_INTERNAL_SPAN_PARENT = "ballista.internal.span_parent"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -556,6 +587,36 @@ def _entries() -> dict[str, ConfigEntry]:
             _parse_prewarm,
         ),
         ConfigEntry(
+            BALLISTA_TRACE,
+            "Distributed query tracing (docs/observability.md): 'off' "
+            "(default — zero overhead, no trace context is ever minted), "
+            "'on' (spans recorded to the bounded in-process ring and "
+            "shipped executor->scheduler for the per-job span tree), or a "
+            "filesystem path (ring + shipping plus JSONL export, one span "
+            "per line, appended). Spans cover plan/verify, stage "
+            "lifecycle, task attempts (incl. retries and lineage "
+            "recompute), per-location shuffle fetch, spill passes, and "
+            "trace-cache misses. The JSONL sink is PROCESS-wide: when "
+            "concurrent sessions configure different paths, the most "
+            "recently submitted session's sink wins for spans recorded "
+            "after it (the ring and shipped spans are unaffected).",
+            "off",
+            _parse_trace,
+        ),
+        ConfigEntry(
+            BALLISTA_METRICS_COLLECTOR,
+            "Executor metrics sink (docs/observability.md): 'shipping' "
+            "(default) meters every operator of a stage fragment and "
+            "serializes per-operator counters/timers into the completed "
+            "TaskStatus — the scheduler aggregates them per (job, stage, "
+            "partition) for /api/job/<id>, /api/metrics, and the AQE "
+            "stats substrate; 'logging' restores the reference's "
+            "LoggingMetricsCollector (annotated plan into the executor "
+            "log, nothing shipped).",
+            "shipping",
+            _parse_metrics_collector,
+        ),
+        ConfigEntry(
             BALLISTA_EAGER_WAIT_S,
             "Deadline (seconds) an eager reader waits for a "
             "not-yet-published upstream location before failing the task "
@@ -715,6 +776,12 @@ class BallistaConfig:
 
     def prewarm(self) -> str:
         return self._get(BALLISTA_PREWARM)
+
+    def trace(self) -> str:
+        return self._get(BALLISTA_TRACE)
+
+    def metrics_collector(self) -> str:
+        return self._get(BALLISTA_METRICS_COLLECTOR)
 
     def __eq__(self, other) -> bool:
         return (

@@ -99,6 +99,12 @@ class EventLoop:
             return
         self._q.put(event)
 
+    def depth(self) -> int:
+        """Events waiting (bounded queue + consumer overflow) — the
+        backpressure signal the /api/metrics plane exposes as
+        ``ballista_event_queue_depth`` (docs/observability.md)."""
+        return self._q.qsize() + len(self._overflow)
+
     def drain(self, timeout: float = 5.0) -> None:
         """Wait until the queue is empty and the worker is idle (tests)."""
         import time

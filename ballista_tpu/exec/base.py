@@ -429,14 +429,29 @@ class Metrics:
         return _Timer(self, name)
 
     def summary(self) -> dict[str, float]:
-        # counters may hold device scalars (recorded without syncing on the
-        # hot path); resolve them here, at report time
+        """Resolved counters + timers in STABLE form: keys sorted (dict
+        insertion order followed recording order, so two runs of the same
+        query could render differently — flaky test assertions and noisy
+        diffs), counters as python ints/floats (device scalars recorded
+        without syncing on the hot path resolve here, at report time),
+        timers always float seconds rounded to microsecond precision."""
         out: dict[str, float] = {
             k: v if isinstance(v, (int, float)) else int(v)
             for k, v in self.counters.items()
         }
-        out.update({k: round(v, 6) for k, v in self.timers.items()})
-        return out
+        out.update({k: round(float(v), 6) for k, v in self.timers.items()})
+        return dict(sorted(out.items()))
+
+    def format(self) -> str:
+        """Pinned display form (tests assert on it verbatim): sorted
+        ``k=v`` pairs, timers with an ``s`` suffix so a counter named like
+        a timer cannot be misread as one."""
+        s = self.summary()
+        parts = [
+            f"{k}={v}s" if k in self.timers else f"{k}={v}"
+            for k, v in s.items()
+        ]
+        return "[" + ", ".join(parts) + "]"
 
 
 def plan_counters(plan, names) -> dict[str, int]:
@@ -503,7 +518,7 @@ class ExecutionPlan:
         def walk(node: "ExecutionPlan", depth: int) -> None:
             line = "  " * depth + node.describe()
             if with_metrics and (node.metrics.counters or node.metrics.timers):
-                line += f"  metrics={node.metrics.summary()}"
+                line += f"  metrics={node.metrics.format()}"
             lines.append(line)
             for c in node.children():
                 walk(c, depth + 1)

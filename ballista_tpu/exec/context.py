@@ -43,6 +43,15 @@ from ballista_tpu.sql.planner import Catalog, SqlPlanner
 from ballista_tpu.tpch import all_schemas  # noqa: F401  (re-export convenience)
 
 
+# Serializes EXPLAIN ANALYZE runs: the verb flips the process-wide
+# BALLISTA_TPU_NO_FUSE env flag for its execution window (see
+# _explain_analyze), and two concurrent runs racing the save/restore
+# could leave it latched on.
+import threading as _threading  # noqa: E402
+
+_ANALYZE_LOCK = _threading.Lock()
+
+
 class _Registered:
     def __init__(self, kind: str, schema: Schema, **kw):
         self.kind = kind  # memory | csv | parquet
@@ -383,6 +392,8 @@ class TpuContext(Catalog, TableProvider):
         if isinstance(stmt, ast.Explain):
             logical = SqlPlanner(self).plan(stmt.query)
             optimized = optimize(logical)
+            if stmt.analyze:
+                return self._explain_analyze(optimized, sql)
             rows = [
                 ("logical_plan", logical.display()),
                 ("optimized_plan", optimized.display()),
@@ -415,6 +426,101 @@ class TpuContext(Catalog, TableProvider):
             df._sql = sql  # verifier diagnostics carry a source span
             return df
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    def _explain_analyze(self, optimized: LogicalPlan, sql: str | None):
+        """EXPLAIN ANALYZE (docs/observability.md): plan, instrument every
+        physical operator (obs.profile), EXECUTE the query to completion,
+        and return the plan re-printed with measured rows/bytes/elapsed
+        per operator plus a run summary. A fresh (uncached) physical plan
+        keeps the metrics this run's own; results are drained, not
+        returned — the verb exists to measure, and the measured counters
+        are exactly the stats substrate the AQE roadmap item re-plans
+        from."""
+        import contextlib
+        import time as _time
+
+        from ballista_tpu.obs import profile
+        from ballista_tpu.obs import trace as obs_trace
+
+        phys = PhysicalPlanner(
+            self,
+            self.config.default_shuffle_partitions(),
+            mesh_runtime=self.mesh_runtime(),
+        ).plan(optimized)
+        if self.config.verify_plans():
+            from ballista_tpu.analysis import verify_physical
+
+            verify_physical(phys, sql=sql)
+        profile.instrument_plan(phys)
+        part = phys.output_partitioning()
+        n = part.n
+
+        def run(ctx: TaskContext) -> int:
+            # fresh metrics per attempt: a capacity-overflow retry
+            # re-executes the same instrumented tree, and accumulating
+            # across attempts would print double-counted rows/elapsed
+            profile.reset_plan_metrics(phys)
+            rows = 0
+            for p in range(n):
+                for b in phys.execute(p, ctx):
+                    rows += 1
+            return rows
+
+        mode = self.config.trace()
+        if mode != "off":
+            # fetch/spill/compile events of this run join a fresh trace
+            obs_trace.configure(mode)
+            span_cm = obs_trace.span(
+                "explain_analyze",
+                trace_id=obs_trace.new_trace_id(),
+                attrs={"sql": (sql or "")[:200]},
+            )
+        else:
+            span_cm = contextlib.nullcontext()
+        self._hints.load_once(self._capacity_hint, self._plan_cache)
+        import os
+
+        # per-operator attribution: Filter/Projection chains normally fuse
+        # into one jitted program whose inner operators never execute
+        # individually (exec/pipeline.py) — ANALYZE runs unfused so every
+        # operator in the printed tree carries its own measured
+        # rows/bytes/elapsed (the summary row says so; production timings
+        # with fusion can only be equal or better). The env flag is
+        # process-wide: the lock serializes concurrent ANALYZE runs (a
+        # save/restore race could latch NO_FUSE on), and an unrelated
+        # query whose chain FIRST executes inside this window runs
+        # unfused — a transient perf effect, never a correctness one,
+        # accepted for a deliberate profiling verb.
+        t0 = _time.perf_counter()
+        with _ANALYZE_LOCK:
+            prev_no_fuse = os.environ.get("BALLISTA_TPU_NO_FUSE")
+            os.environ["BALLISTA_TPU_NO_FUSE"] = "1"
+            try:
+                with span_cm:
+                    run_with_capacity_retry(
+                        self.config, run, hint=self._capacity_hint,
+                        plan_cache=self._plan_cache,
+                    )
+            finally:
+                if prev_no_fuse is None:
+                    os.environ.pop("BALLISTA_TPU_NO_FUSE", None)
+                else:
+                    os.environ["BALLISTA_TPU_NO_FUSE"] = prev_no_fuse
+        elapsed = _time.perf_counter() - t0
+        self._hints.save_if_changed(self._capacity_hint, self._plan_cache)
+        rows = [
+            ("physical_plan (analyzed)", profile.annotated_display(phys)),
+            ("analyze_summary",
+             f"total_elapsed={elapsed:.6f}s, fusion=off "
+             "(per-operator attribution)"),
+        ]
+        t = pa.table(
+            {
+                "plan_type": pa.array([r[0] for r in rows]),
+                "plan": pa.array([r[1] for r in rows]),
+            }
+        )
+        return DataFrame.from_arrow(self, t)
 
     def _verify_report(self, optimized: LogicalPlan, phys, sql: str) -> str:
         """EXPLAIN VERIFY body: run the logical + physical verifier passes

@@ -173,6 +173,21 @@ class SpillSet:
             return
         self._closed = True
         self.finish_writes()
+        total = sum(self.bucket_bytes)
+        if total:
+            # tracing (docs/observability.md): one point event per spill
+            # set, parented to the ambient task-attempt span — a no-op
+            # (one thread-local read) when the session doesn't trace
+            from ballista_tpu.obs import trace as obs_trace
+
+            obs_trace.event(
+                "spill_pass",
+                attrs={
+                    "buckets": self.buckets,
+                    "bytes": total,
+                    "rows": sum(self.bucket_rows),
+                },
+            )
         shutil.rmtree(self.dir, ignore_errors=True)
 
 

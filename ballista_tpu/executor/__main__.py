@@ -86,6 +86,14 @@ def build_parser() -> argparse.ArgumentParser:
         "(docs/compile_cache.md): 'on' blocks until warm, 'background' "
         "compiles while serving",
     )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=int(_env("metrics_port", 0)),
+        help="serve Prometheus text metrics (GET /api/metrics) on this "
+        "port — the executor-side scrape surface (docs/observability.md); "
+        "0 disables",
+    )
     p.add_argument("--log-level", default=_env("log_level", "INFO"))
     return p
 
@@ -154,11 +162,24 @@ def main(argv: list[str] | None = None) -> int:
             args.job_data_clean_up_interval_seconds,
         )
 
+    metrics_httpd = None
+    if args.metrics_port:
+        from ballista_tpu.obs import prometheus as prom
+
+        metrics_httpd, mport = prom.start_metrics_server(
+            prom.executor_families, args.bind_host, args.metrics_port
+        )
+        log.info("metrics on %s:%d/api/metrics", args.bind_host, mport)
+
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
     log.info("shutting down")
+    if metrics_httpd is not None:
+        from ballista_tpu.obs.prometheus import stop_metrics_server
+
+        stop_metrics_server(metrics_httpd)
     worker.stop()
     return 0
 

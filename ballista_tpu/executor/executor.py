@@ -8,6 +8,7 @@ report status on next poll).
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import queue
 import threading
@@ -77,9 +78,10 @@ class Executor:
 
         self._hints = HintStore()
         self._hints.load_once(self._capacity_hint, self._plan_cache)
-        from ballista_tpu.executor.metrics import LoggingMetricsCollector
-
-        self.metrics_collector = metrics_collector or LoggingMetricsCollector()
+        # None = resolve per task from the session's declared
+        # ballista.tpu.metrics_collector (shipping by default); an
+        # explicitly constructed collector wins (tests, embedders)
+        self.metrics_collector = metrics_collector
 
     # -- eager-shuffle location polling (docs/shuffle.md) --------------------
     def _locations_client(self):
@@ -176,19 +178,27 @@ class Executor:
 
     def execute_shuffle_write(
         self, task: pb.TaskDefinition
-    ) -> list:
+    ) -> "TaskRunOutput":
         """Decode + rebind work_dir + run one input partition
-        (ref executor.rs:81-114)."""
+        (ref executor.rs:81-114). Returns the written partition metas plus
+        the per-operator metrics the session's collector chose to ship."""
         from ballista_tpu.config import (
             BALLISTA_INTERNAL_PREFIX,
+            BALLISTA_INTERNAL_SPAN_PARENT,
             BALLISTA_INTERNAL_TASK_ATTEMPT,
+            BALLISTA_INTERNAL_TRACE_ID,
         )
 
         props_early = {kv.key: kv.value for kv in task.props}
-        # task-scoped internal keys (attempt number) are NOT session config:
-        # strip them before BallistaConfig validation rejects the unknown
-        # prefix
+        # task-scoped internal keys (attempt number, trace context) are NOT
+        # session config: strip them before BallistaConfig validation
+        # rejects the unknown prefix
         attempt = int(props_early.get(BALLISTA_INTERNAL_TASK_ATTEMPT, "0"))
+        # distributed tracing (docs/observability.md): the scheduler stamps
+        # these only when the session traces, so "no prop" IS the
+        # zero-overhead off path
+        trace_id = props_early.get(BALLISTA_INTERNAL_TRACE_ID, "")
+        span_parent = props_early.get(BALLISTA_INTERNAL_SPAN_PARENT, "")
         props_early = {
             k: v
             for k, v in props_early.items()
@@ -240,6 +250,38 @@ class Executor:
             from ballista_tpu.analysis import verify_physical
 
             verify_physical(plan)
+        from ballista_tpu.executor.metrics import collector_for
+
+        collector = collector_for(config, self.metrics_collector)
+        if collector.wants_instrumentation():
+            # per-operator rows/bytes/elapsed metering (obs.profile):
+            # wrapped BEFORE execution; counters stay lazy device scalars
+            # on the hot path and resolve once at record_stage
+            from ballista_tpu.obs import profile
+
+            profile.instrument_plan(plan)
+        import contextlib
+
+        from ballista_tpu.obs import trace as obs_trace
+
+        if trace_id:
+            # executor-side JSONL export follows the session's trace mode;
+            # the span ships home on the next poll/status RPC either way
+            obs_trace.configure(config.trace())
+            span_cm = obs_trace.span(
+                "task_attempt",
+                trace_id=trace_id,
+                parent_id=span_parent,
+                attrs={
+                    "job_id": task.task_id.job_id,
+                    "stage_id": task.task_id.stage_id,
+                    "partition": task.task_id.partition_id,
+                    "attempt": attempt,
+                    "executor_id": self.executor_id,
+                },
+            )
+        else:
+            span_cm = contextlib.nullcontext()
         # attempt-isolated speculation cache: run against a SNAPSHOT and
         # commit only on success. A failed attempt (injected crash, lost
         # shuffle fetch midway) has executed part of the plan and recorded
@@ -249,37 +291,68 @@ class Executor:
         # drift in aggregates, breaking the chaos suite's bit-exact
         # recovery guarantee (docs/fault_tolerance.md).
         attempt_cache = dict(self._plan_cache)
-        out = run_with_capacity_retry(
-            config,
-            lambda ctx: plan.execute_shuffle_write(
+
+        def attempt(ctx):
+            # fresh metrics per ATTEMPT: a capacity/speculation retry
+            # re-executes this same plan instance, and accumulating
+            # across attempts would ship double-counted rows/bytes
+            # (obs.profile.reset_plan_metrics)
+            if collector.wants_instrumentation():
+                from ballista_tpu.obs import profile as _profile
+
+                _profile.reset_plan_metrics(plan)
+            return plan.execute_shuffle_write(
                 task.task_id.partition_id, ctx
-            ),
-            hint=self._capacity_hint,
-            plan_cache=attempt_cache,
-            # plan instances are decoded fresh per task: instance-held
-            # build caches would die with the task while charging the
-            # shared HBM tally (see TaskContext.cache_builds)
-            cache_builds=False,
-            session_id=task.session_id,
-            job_id=task.task_id.job_id,
-            work_dir=self.work_dir,
-            shuffle_locations=(
-                self.shuffle_locations if self.scheduler_addr else None
-            ),
-        )
+            )
+
+        with span_cm:
+            out = run_with_capacity_retry(
+                config,
+                attempt,
+                hint=self._capacity_hint,
+                plan_cache=attempt_cache,
+                # plan instances are decoded fresh per task: instance-held
+                # build caches would die with the task while charging the
+                # shared HBM tally (see TaskContext.cache_builds)
+                cache_builds=False,
+                session_id=task.session_id,
+                job_id=task.task_id.job_id,
+                work_dir=self.work_dir,
+                shuffle_locations=(
+                    self.shuffle_locations if self.scheduler_addr else None
+                ),
+            )
         self._plan_cache.update(attempt_cache)
         self._hints.save_if_changed(self._capacity_hint, self._plan_cache)
-        self.metrics_collector.record_stage(
+        op_metrics = collector.record_stage(
             task.task_id.job_id, task.task_id.stage_id,
             task.task_id.partition_id, plan,
         )
-        return out
+        return TaskRunOutput(partitions=out, operator_metrics=op_metrics)
+
+
+@dataclasses.dataclass
+class TaskRunOutput:
+    """What one task attempt produced: the written shuffle partition metas
+    plus (when the session's collector ships) the per-operator metric
+    records. Iterable over the metas for callers that only care about
+    partitions (tests, as_task_status)."""
+
+    partitions: list
+    operator_metrics: list | None = None
+
+    def __iter__(self):
+        return iter(self.partitions)
+
+    def __len__(self) -> int:
+        return len(self.partitions)
 
 
 def as_task_status(
     task_id: pb.PartitionId, executor_id: str, result, error: str | None
 ) -> pb.TaskStatus:
-    """ref executor/src/lib.rs:39-68."""
+    """ref executor/src/lib.rs:39-68. ``result``: a TaskRunOutput (the
+    executor path) or a bare meta list (tests / legacy callers)."""
     st = pb.TaskStatus(task_id=task_id)
     if error is not None:
         st.failed.CopyFrom(pb.FailedTask(error=error[:4096]))
@@ -299,6 +372,13 @@ def as_task_status(
             ],
         )
     )
+    op_metrics = getattr(result, "operator_metrics", None)
+    if op_metrics:
+        from ballista_tpu.obs import profile
+
+        st.completed.operator_metrics.extend(
+            profile.metrics_to_proto(op_metrics)
+        )
     return st
 
 
@@ -337,7 +417,11 @@ class PollLoop:
 
     def start(self) -> None:
         from ballista_tpu.compilecache.prewarm import start_server_prewarm
+        from ballista_tpu.obs import trace as obs_trace
 
+        # executor role: recorded spans stage in the outbox and ride the
+        # poll home (docs/observability.md)
+        obs_trace.enable_shipping(True)
         self._prewarm = start_server_prewarm(self.prewarm_mode)
         self._thread = threading.Thread(
             target=self.run, daemon=True, name="executor-poll-loop"
@@ -408,7 +492,9 @@ class PollLoop:
             if can_accept:
                 self._available.release()
             from ballista_tpu.compilecache import metrics as compile_metrics
+            from ballista_tpu.obs import trace as obs_trace
 
+            spans = obs_trace.drain_outbox()
             try:
                 result = stub.PollWork(
                     pb.PollWorkParams(
@@ -421,15 +507,20 @@ class PollLoop:
                             pb.KeyValuePair(key=k, value=str(v))
                             for k, v in compile_metrics.snapshot().items()
                         ],
+                        # drained trace spans ride the same liveness RPC
+                        # (docs/observability.md)
+                        spans=[obs_trace.span_to_proto(s) for s in spans],
                     )
                 )
             except grpc.RpcError as e:
                 log.warning("poll_work failed: %s", e)
-                # re-enqueue the drained statuses for the next successful
-                # poll — dropping them left tasks RUNNING forever on the
-                # scheduler (statuses are reported exactly once)
+                # re-enqueue the drained statuses (and spans) for the next
+                # successful poll — dropping them left tasks RUNNING
+                # forever on the scheduler (statuses are reported exactly
+                # once; spans are shipped exactly once too)
                 for st in statuses:
                     self._statuses.put(st)
+                obs_trace.requeue_outbox(spans)
                 time.sleep(1.0)
                 continue
             if result.HasField("task"):

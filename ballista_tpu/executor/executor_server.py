@@ -107,7 +107,11 @@ class ExecutorServer:
         # offers slots to a cold executor, 'background' overlaps warm-up
         # with registration and is joined in stop()
         from ballista_tpu.compilecache.prewarm import start_server_prewarm
+        from ballista_tpu.obs import trace as obs_trace
 
+        # executor role: recorded spans stage in the outbox and ride the
+        # heartbeat/status RPCs home (docs/observability.md)
+        obs_trace.enable_shipping(True)
         self._prewarm = start_server_prewarm(self.prewarm_mode)
 
         gs = grpc.server(ThreadPoolExecutor(max_workers=8))
@@ -175,7 +179,9 @@ class ExecutorServer:
                 # this executor go silent
                 continue
             from ballista_tpu.compilecache import metrics as compile_metrics
+            from ballista_tpu.obs import trace as obs_trace
 
+            spans = obs_trace.drain_outbox()
             try:
                 result = self._sched.HeartBeatFromExecutor(
                     pb.HeartBeatParams(
@@ -187,6 +193,9 @@ class ExecutorServer:
                             pb.KeyValuePair(key=k, value=str(v))
                             for k, v in compile_metrics.snapshot().items()
                         ],
+                        # trace spans not already shipped with a task
+                        # status (flight serve spans, stragglers)
+                        spans=[obs_trace.span_to_proto(s) for s in spans],
                     ),
                     timeout=RPC_TIMEOUT_S,
                 )
@@ -213,6 +222,9 @@ class ExecutorServer:
                     )
             except grpc.RpcError as e:
                 log.warning("heartbeat failed: %s", e)
+                # spans ship exactly once: a failed beat re-queues what it
+                # drained for the next one
+                obs_trace.requeue_outbox(spans)
 
     def _runner_loop(self) -> None:
         """ref run_task :176-254 — decode, execute, push status back."""
@@ -231,16 +243,23 @@ class ExecutorServer:
             status = as_task_status(
                 task.task_id, self.executor.executor_id, result, error
             )
+            from ballista_tpu.obs import trace as obs_trace
+
+            # drain trace spans with the status so task-attempt spans
+            # arrive WITH their completion, not a heartbeat later
+            spans = obs_trace.drain_outbox()
             try:
                 self._sched.UpdateTaskStatus(
                     pb.UpdateTaskStatusParams(
                         executor_id=self.executor.executor_id,
                         task_status=[status],
+                        spans=[obs_trace.span_to_proto(s) for s in spans],
                     ),
                     timeout=RPC_TIMEOUT_S,
                 )
             except grpc.RpcError as e:
                 log.warning("UpdateTaskStatus failed: %s", e)
+                obs_trace.requeue_outbox(spans)
 
     def stop(self) -> None:
         """Graceful drain: signal, then JOIN the heartbeater and every

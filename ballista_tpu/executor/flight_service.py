@@ -65,16 +65,20 @@ class BallistaFlightService(paflight.FlightServerBase):
         fp = action.fetch_partition
         path = self._contained_path(fp.path)
 
-        from ballista_tpu.config import BALLISTA_SHUFFLE_COMPRESSION
-
-        codec = next(
-            (
-                kv.value
-                for kv in action.settings
-                if kv.key == BALLISTA_SHUFFLE_COMPRESSION
-            ),
-            "",
+        from ballista_tpu.config import (
+            BALLISTA_INTERNAL_SPAN_PARENT,
+            BALLISTA_INTERNAL_TRACE_ID,
+            BALLISTA_SHUFFLE_COMPRESSION,
         )
+
+        settings = {kv.key: kv.value for kv in action.settings}
+        codec = settings.get(BALLISTA_SHUFFLE_COMPRESSION, "")
+        # distributed tracing (docs/observability.md): the consumer's
+        # trace context rides the ticket; the serve span joins its trace
+        # (parented to the consumer's shuffle_fetch span) and ships home
+        # on this executor's next poll/heartbeat
+        trace_id = settings.get(BALLISTA_INTERNAL_TRACE_ID, "")
+        span_parent = settings.get(BALLISTA_INTERNAL_SPAN_PARENT, "")
         options = (
             paipc.IpcWriteOptions(compression=codec)
             if codec in _STREAM_CODECS
@@ -113,7 +117,22 @@ class BallistaFlightService(paflight.FlightServerBase):
         # finally closes the fd DETERMINISTICALLY on exhaustion, on a
         # mid-stream fault, and on client cancellation (Flight closes the
         # generator) instead of leaving each request's fd to GC.
-        def batches(r=reader, src=source, tok=src_tok):
+        serve_span = None
+        if trace_id:
+            from ballista_tpu.obs import trace as obs_trace
+
+            serve_span = obs_trace.start(
+                "flight_serve",
+                trace_id,
+                span_parent,
+                attrs={
+                    "job_id": fp.job_id,
+                    "stage_id": fp.stage_id,
+                    "partition": fp.partition_id,
+                },
+            )
+
+        def batches(r=reader, src=source, tok=src_tok, span=serve_span):
             try:
                 # priming yield (consumed below, never streamed): a
                 # generator that was never STARTED does not run its
@@ -133,9 +152,24 @@ class BallistaFlightService(paflight.FlightServerBase):
                             path=path,
                         )
                     yield r.get_batch(i)
+            except GeneratorExit:
+                # client-side stream close (cancel, LIMIT) is a clean
+                # end of serving, not a serve failure
+                if span is not None:
+                    span.attrs["cancelled"] = 1
+                raise
+            except BaseException as e:
+                if span is not None:
+                    span.outcome = "error"
+                    span.attrs["error"] = type(e).__name__
+                raise
             finally:
                 src.close()
                 reswitness.release(tok)
+                if span is not None:
+                    from ballista_tpu.obs import trace as obs_trace
+
+                    obs_trace.finish(span, span.outcome)
 
         gen = batches()
         next(gen)  # enter the try: cleanup now runs on any outcome

@@ -126,6 +126,7 @@ def fetch_partition_batches(
     timeout_s: float | None = None,
     compression: str = "",
     local_fastpath: bool = True,
+    trace_ctx: tuple[str, str] | None = None,
 ) -> Iterator[pa.RecordBatch]:
     """One shuffle file -> record-batch stream; peak memory is a batch,
     not the partition (ref shuffle_reader.rs streams batches through the
@@ -139,7 +140,10 @@ def fetch_partition_batches(
 
     ``compression`` asks the SERVING executor to compress the Flight
     stream with that codec (files are self-describing, so the local path
-    ignores it)."""
+    ignores it). ``trace_ctx`` — the consuming task's (trace_id,
+    span_id): remote fetches carry it in the Flight ticket settings so
+    the serving executor's serve span joins the same trace
+    (docs/observability.md)."""
     if local_fastpath and os.path.exists(loc.path):
         from ballista_tpu.testing import faults
 
@@ -174,7 +178,10 @@ def fetch_partition_batches(
             raise _local_fetch_error(loc, e) from e
     from ballista_tpu.client.flight import fetch_partition_batches as remote
 
-    yield from remote(loc, retries, backoff_ms, timeout_s, compression)
+    yield from remote(
+        loc, retries, backoff_ms, timeout_s, compression,
+        trace_ctx=trace_ctx,
+    )
 
 
 def _inject_local_fetch_faults(
@@ -270,6 +277,13 @@ class _EagerFeed:
                 "executor (TaskContext.shuffle_locations); eager plans "
                 "are only dispatched by the scheduler"
             )
+        from ballista_tpu.obs import trace as obs_trace
+
+        # tracing: the feed is built on the consumer task's thread, so
+        # the ambient context here IS the task-attempt span — poll events
+        # recorded against it nest under the consumer task
+        # (docs/observability.md); None when the session doesn't trace
+        self._trace_parent = obs_trace.current()
         self._poll: Callable = ctx.shuffle_locations
         self.job_id = job_id
         self.stage_id = stage_id
@@ -312,6 +326,22 @@ class _EagerFeed:
         for mt, loc in ready:
             self._pending.append(loc)
             self._next_map = mt + 1
+        if ready and self._trace_parent is not None:
+            # span volume bounded by #map tasks: only polls that made
+            # progress are recorded, not the 10ms-cadence empty ones
+            from ballista_tpu.obs import trace as obs_trace
+
+            obs_trace.event(
+                "eager_poll",
+                trace_id=self._trace_parent[0],
+                parent_id=self._trace_parent[1],
+                attrs={
+                    "stage_id": self.stage_id,
+                    "partition": self.partition,
+                    "new_locations": len(ready),
+                    "next_map": self._next_map,
+                },
+            )
         if upto is not None:
             # empty producers below the prefix publish no file; skip them
             self._next_map = max(self._next_map, upto)
@@ -358,6 +388,50 @@ class _EagerFeed:
                 )
             self._metrics.add("eager_waits")
             _time.sleep(self._interval_s)
+
+
+def _traced_fetch(
+    inner: Iterator[pa.RecordBatch],
+    loc: PartitionLocation,
+    parent: tuple[str, str],
+) -> Iterator[pa.RecordBatch]:
+    """Wrap one location's fetch stream in a ``shuffle_fetch`` span with
+    an EXPLICIT parent (no thread-local push: overlapped fetches run on
+    pool threads, and a generator-held ambient context would leak onto
+    whatever else the thread runs between yields)."""
+    from ballista_tpu.obs import trace as obs_trace
+
+    s = obs_trace.start(
+        "shuffle_fetch",
+        parent[0],
+        parent[1],
+        attrs={
+            "stage_id": loc.stage_id,
+            "partition": loc.partition,
+            "executor_id": loc.executor_id,
+            "host": loc.host,
+        },
+    )
+    rows = 0
+    try:
+        for rb in inner:
+            rows += rb.num_rows
+            yield rb
+    except GeneratorExit:
+        # an early-stopping consumer (LIMIT) is a CLEAN close, not a
+        # fetch failure — the span stays ok, tagged cancelled
+        s.attrs["cancelled"] = 1
+        raise
+    except BaseException as e:
+        s.outcome = "error"
+        s.attrs["error"] = type(e).__name__
+        raise
+    finally:
+        close = getattr(inner, "close", None)
+        if close is not None:
+            close()
+        s.attrs["rows"] = rows
+        obs_trace.finish(s, s.outcome)
 
 
 # ---------------------------------------------------------------------------
@@ -562,12 +636,22 @@ class ShuffleReaderExec(ExecutionPlan):
         timeout_s = ctx.config.fetch_timeout_s()
         compression = ctx.config.shuffle_compression()
         local_fastpath = ctx.config.shuffle_local_fastpath()
+        # tracing (docs/observability.md): execute() runs on the task
+        # thread, where the ambient context is the task-attempt span (when
+        # the session traces) — captured HERE and passed explicitly, since
+        # overlapped fetches run on pool threads
+        from ballista_tpu.obs import trace as obs_trace
+
+        trace_parent = obs_trace.current()
 
         def fetch_one(loc: PartitionLocation) -> Iterator[pa.RecordBatch]:
-            return fetch_partition_batches(
+            it = fetch_partition_batches(
                 loc, retries, backoff_ms, timeout_s, compression,
-                local_fastpath,
+                local_fastpath, trace_ctx=trace_parent,
             )
+            if trace_parent is None:
+                return it
+            return _traced_fetch(it, loc, trace_parent)
 
         if self.eager:
             feed = _EagerFeed(

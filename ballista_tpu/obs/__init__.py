@@ -1,0 +1,20 @@
+"""Query-level observability (docs/observability.md).
+
+Three planes over the client -> scheduler -> executor -> kernel stack:
+
+- :mod:`ballista_tpu.obs.trace` — distributed tracing: a
+  ``trace_id``/``span_id`` context minted at job submission, propagated
+  through task props / Flight ticket settings, recorded to a bounded
+  in-process ring with optional JSONL export, and shipped executor ->
+  scheduler on poll/heartbeat/status RPCs so chaos tests can assert the
+  SHAPE of a recovery (kill -> invalidate -> recompute -> promote).
+- :mod:`ballista_tpu.obs.profile` — per-operator runtime metrics:
+  a plan-tree instrumentation pass metering rows/bytes/elapsed per
+  physical operator (the EXPLAIN ANALYZE substrate and the stats feed
+  for the adaptive-query-execution roadmap item).
+- :mod:`ballista_tpu.obs.prometheus` — the scrapeable metrics plane:
+  Prometheus text rendering of scheduler/executor counters served at
+  ``GET /api/metrics``.
+"""
+
+from ballista_tpu.obs import trace  # noqa: F401 (re-export convenience)

@@ -1,0 +1,223 @@
+"""The scrapeable metrics plane: Prometheus text exposition.
+
+``GET /api/metrics`` on the scheduler REST server (scheduler/rest.py)
+renders :func:`scheduler_families`; executor daemons can serve the same
+format from a tiny stdlib HTTP server (:func:`start_metrics_server`,
+wired behind ``--metrics-port`` in ``executor/__main__.py``) rendering
+:func:`executor_families`. What was scattered — compile counters on
+heartbeats, shuffle fetch-overlap counters in per-operator metrics,
+retry/recompute totals in job records, queue depth inside the event
+loop, live-resource counts in the reswitness — unifies into one
+text/plain surface (Prometheus exposition format 0.0.4; a parser-level
+tier-1 test pins validity).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+
+log = logging.getLogger(__name__)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_OK = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def sanitize_name(name: str) -> str:
+    name = _NAME_OK.sub("_", name)
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def render(families: list[tuple]) -> str:
+    """``families``: [(name, type, help, [(labels-dict, value), ...])].
+    Renders valid exposition text: one ``# HELP``/``# TYPE`` header per
+    family, samples sorted by label for output stability."""
+    out: list[str] = []
+    for name, mtype, help_text, samples in families:
+        name = sanitize_name(name)
+        out.append(f"# HELP {name} {help_text}")
+        out.append(f"# TYPE {name} {mtype}")
+        for labels, value in sorted(
+            samples, key=lambda s: sorted(s[0].items())
+        ):
+            if labels:
+                body = ",".join(
+                    f'{_LABEL_OK.sub("_", k)}="{_esc(v)}"'
+                    for k, v in sorted(labels.items())
+                )
+                out.append(f"{name}{{{body}}} {_fmt(value)}")
+            else:
+                out.append(f"{name} {_fmt(value)}")
+    return "\n".join(out) + "\n"
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def scheduler_families(server) -> list[tuple]:
+    """The scheduler's metric families, read through the same locked
+    accessors the REST state payload uses."""
+    import time
+
+    em = server.executor_manager
+    now = time.time()
+    with server._lock:
+        jobs = list(server.jobs.values())
+        task_counters = dict(server.obs_task_counters)
+    status_counts: dict[str, int] = {}
+    retries = recomputes = 0
+    for j in jobs:
+        status_counts[j.status] = status_counts.get(j.status, 0) + 1
+        retries += j.total_retries
+        recomputes += j.total_recomputes
+    free = total = alive = devices = 0
+    compile_samples: list[tuple] = []
+    alive_ids = em.get_alive_executors(server.executor_timeout_s)
+    for meta in em.all_executors():
+        data = em.get_executor_data(meta.id)
+        if data is not None:
+            free += data.available_task_slots
+            total += data.total_task_slots
+        if meta.id in alive_ids:
+            alive += 1
+            devices += meta.specification.n_devices or 1
+        for k, v in (em.get_executor_metrics(meta.id) or {}).items():
+            compile_samples.append(
+                ({"executor": meta.id, "counter": sanitize_name(k)}, v)
+            )
+    families = [
+        ("ballista_uptime_seconds", "gauge", "Scheduler uptime",
+         [({}, now - server.start_time)]),
+        ("ballista_executors_alive", "gauge", "Alive executors",
+         [({}, alive)]),
+        ("ballista_mesh_devices", "gauge", "Devices across alive executors",
+         [({}, devices)]),
+        ("ballista_task_slots", "gauge", "Task slots by state",
+         [({"state": "free"}, free), ({"state": "total"}, total)]),
+        ("ballista_jobs", "gauge", "Jobs by status",
+         [({"status": s}, n) for s, n in sorted(status_counts.items())]),
+        ("ballista_task_retries_total", "counter",
+         "Bounded task retries across all jobs", [({}, retries)]),
+        ("ballista_recomputes_total", "counter",
+         "Lost-shuffle recompute rounds across all jobs", [({}, recomputes)]),
+        ("ballista_event_queue_depth", "gauge",
+         "Scheduler event-loop queue depth (bounded queue + overflow)",
+         [({}, server.event_loop.depth())]),
+        ("ballista_inflight_tasks", "gauge",
+         "Pending + running tasks (the KEDA scale signal)",
+         [({}, server.stage_manager.inflight_tasks())]),
+    ]
+    if compile_samples:
+        families.append(
+            ("ballista_executor_compile", "gauge",
+             "Latest compile-latency counter snapshot per executor "
+             "(docs/compile_cache.md)", compile_samples)
+        )
+    if task_counters:
+        families.append(
+            ("ballista_task_counter_total", "counter",
+             "Per-operator counters aggregated from shipped task metrics "
+             "(shuffle fetched bytes/overlap, spill, write/repart time)",
+             [({"counter": sanitize_name(k)}, v)
+              for k, v in sorted(task_counters.items())])
+        )
+    families.extend(_reswitness_families())
+    return families
+
+
+def executor_families() -> list[tuple]:
+    """The executor-process metric families (compile counters + the
+    in-process trace ring size + live resources)."""
+    from ballista_tpu.compilecache import metrics as compile_metrics
+    from ballista_tpu.obs import trace
+
+    families = [
+        ("ballista_executor_compile", "gauge",
+         "Compile-latency counters (docs/compile_cache.md)",
+         [({"counter": sanitize_name(k)}, v)
+          for k, v in compile_metrics.snapshot().items()]),
+        ("ballista_trace_ring_spans", "gauge",
+         "Spans currently buffered in the in-process trace ring",
+         [({}, trace.ring_size())]),
+    ]
+    families.extend(_reswitness_families())
+    return families
+
+
+def _reswitness_families() -> list[tuple]:
+    """Live resource counts when the runtime resource witness is on
+    (BALLISTA_RESOURCE_WITNESS=1) — empty otherwise."""
+    from ballista_tpu.analysis import reswitness
+
+    if not reswitness.enabled():
+        return []
+    counts: dict[str, int] = {}
+    for rec in reswitness.live():
+        counts[rec.get("kind", "?")] = counts.get(rec.get("kind", "?"), 0) + 1
+    return [
+        ("ballista_live_resources", "gauge",
+         "Live witnessed resources by kind (analysis/reswitness.py)",
+         [({"kind": k}, v) for k, v in sorted(counts.items())] or [({}, 0)])
+    ]
+
+
+# ---------------------------------------------------------------------------
+# tiny standalone metrics endpoint (executor daemons)
+# ---------------------------------------------------------------------------
+
+
+def start_metrics_server(render_fn, host: str = "0.0.0.0", port: int = 0):
+    """Serve ``GET /api/metrics`` (and ``/metrics``) rendering
+    ``render_fn() -> families``. Returns (httpd, bound_port); stop with
+    :func:`stop_metrics_server` — the same shutdown+join+server_close
+    discipline as the scheduler REST server (lifelint: the listening
+    socket must close)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            path = self.path.split("?", 1)[0].rstrip("/")
+            if path not in ("/api/metrics", "/metrics"):
+                self.send_error(404)
+                return
+            try:
+                body = render(render_fn()).encode()
+            except Exception:  # noqa: BLE001 — a scrape must not crash
+                log.exception("metrics render failed")
+                self.send_error(500)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            log.debug("metrics: " + fmt, *args)
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(
+        target=httpd.serve_forever, daemon=True, name="executor-metrics"
+    )
+    httpd._serve_thread = t
+    t.start()
+    return httpd, httpd.server_address[1]
+
+
+def stop_metrics_server(httpd) -> None:
+    httpd.shutdown()
+    t = getattr(httpd, "_serve_thread", None)
+    if t is not None:
+        t.join(timeout=5)
+    httpd.server_close()

@@ -215,6 +215,11 @@ def scheduler_state(server) -> dict:
                 "last_seen_seconds_ago": (
                     round(now - seen, 3) if seen is not None else None
                 ),
+                # REST-hardening alias (docs/observability.md): the
+                # monitoring-friendly name dashboards expect
+                "last_heartbeat_age_s": (
+                    round(now - seen, 3) if seen is not None else None
+                ),
                 # latest compile-latency counters (traces, XLA compiles,
                 # persistent-cache hits/misses, prewarm progress) the
                 # executor shipped on its heartbeat/poll
@@ -248,6 +253,8 @@ def scheduler_state(server) -> dict:
         "jobs": jobs,
         "started": int(server.start_time * 1000),
         "uptime_seconds": now - server.start_time,
+        # monitoring-friendly alias (docs/observability.md)
+        "uptime_s": round(now - server.start_time, 3),
         "policy": server.policy.value,
         "version": BALLISTA_VERSION,
     }
@@ -275,12 +282,29 @@ def job_detail(server, job_id: str) -> dict | None:
                     "plan": job.stages[sid].plan.display(),
                 }
             )
-        return {
+        out = {
             "job_id": job_id,
             "status": job.status,
+            "error": job.error,
             "final_stage_id": job.final_stage_id,
             "stages": stages,
+            "retries": job.total_retries,
+            "recomputes": job.total_recomputes,
+            "trace_id": job.trace_id,
         }
+    # stats/trace aggregation takes the server lock itself — outside the
+    # block above (the lock is reentrant, but the narrower the section
+    # the better)
+    stats = server.job_stats(job_id)
+    if stats is not None:
+        # per-stage / per-(stage,partition) rows+bytes+attempts plus the
+        # shipped per-operator metrics (docs/observability.md) — live
+        # while running, from the completion snapshot afterwards
+        out.update(stats)
+    trace = server.job_trace(job_id)
+    if trace:
+        out["spans"] = trace
+    return out
 
 
 def start_rest_server(server, host: str = "0.0.0.0", port: int = 0):
@@ -288,17 +312,55 @@ def start_rest_server(server, host: str = "0.0.0.0", port: int = 0):
     (httpd, bound_port)."""
 
     class Handler(BaseHTTPRequestHandler):
+        def _reply(self, status: int, body: bytes, ctype: str) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):  # noqa: N802 (http.server API)
             path = self.path.split("?", 1)[0].rstrip("/") or "/"
             if path in ("/api/state", "/state"):
                 body = json.dumps(scheduler_state(server)).encode()
                 ctype = "application/json"
+            elif path in ("/api/metrics", "/metrics"):
+                # the scrapeable metrics plane (docs/observability.md):
+                # Prometheus text exposition of scheduler + shipped
+                # executor counters. Guarded like the executor-side
+                # endpoint: a scrape racing executor expiry must get a
+                # 500, not an aborted connection.
+                from ballista_tpu.obs import prometheus as prom
+
+                try:
+                    body = prom.render(
+                        prom.scheduler_families(server)
+                    ).encode()
+                except Exception:  # noqa: BLE001
+                    log.exception("metrics render failed")
+                    self._reply(
+                        500,
+                        json.dumps({"error": "metrics render failed"}).encode(),
+                        "application/json",
+                    )
+                    return
+                ctype = prom.CONTENT_TYPE
             elif path.startswith("/api/job/"):
                 from urllib.parse import unquote
 
-                detail = job_detail(server, unquote(path[len("/api/job/"):]))
+                job_id = unquote(path[len("/api/job/"):])
+                detail = job_detail(server, job_id)
                 if detail is None:
-                    self.send_error(404)
+                    # REST hardening: a proper 404 with a JSON body (the
+                    # stdlib send_error serves an HTML error page, which
+                    # API clients then fail to parse on top of the 404)
+                    self._reply(
+                        404,
+                        json.dumps(
+                            {"error": "unknown job", "job_id": job_id}
+                        ).encode(),
+                        "application/json",
+                    )
                     return
                 body = json.dumps(detail).encode()
                 ctype = "application/json"
@@ -306,13 +368,13 @@ def start_rest_server(server, host: str = "0.0.0.0", port: int = 0):
                 body = _UI_PAGE.encode()
                 ctype = "text/html; charset=utf-8"
             else:
-                self.send_error(404)
+                self._reply(
+                    404,
+                    json.dumps({"error": "not found", "path": path}).encode(),
+                    "application/json",
+                )
                 return
-            self.send_response(200)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._reply(200, body, ctype)
 
         def log_message(self, fmt, *args):
             log.debug("rest: " + fmt, *args)

@@ -14,6 +14,7 @@ event loop (scheduler_server/query_stage_scheduler.rs:40-473):
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import logging
 import random
@@ -119,6 +120,28 @@ class JobInfo:
     max_attempts: int = 3
     total_retries: int = 0
     total_recomputes: int = 0
+    # observability (docs/observability.md). trace_id is minted at
+    # submission when the session's ballista.tpu.trace is not "off";
+    # empty trace_id IS the zero-overhead off path (no span is ever
+    # created for this job anywhere in the system).
+    trace_id: str = ""
+    root_span_id: str = ""
+    # open stage spans (obs.trace.Span), by stage id — their span_id is
+    # the parent stamped onto task-attempt props
+    stage_spans: dict = dataclasses.field(default_factory=dict)
+    # the job's reassembled span store, keyed by span_id (dict = dedup:
+    # in-proc standalone clusters can see a scheduler-recorded span come
+    # back through the executor shipping path)
+    spans: dict = dataclasses.field(default_factory=dict)
+    # per-(stage_id, partition) operator-metric records shipped home in
+    # CompletedTask (obs.profile.operator_metrics shape)
+    op_metrics: dict = dataclasses.field(default_factory=dict)
+    # per-stage/per-task stats snapshot taken at job completion/failure —
+    # the stage bookkeeping is torn down then, and /api/job must keep
+    # serving the run's stats afterwards
+    stage_stats: list | None = None
+    # the OPEN root span (finished at job completion/failure)
+    root_span: object = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -218,6 +241,19 @@ class SchedulerServer:
         self._launch_failures: dict[str, int] = {}
         self.max_launch_failures = 3
         self._lock = make_lock("SchedulerServer._lock", reentrant=True)
+        # observability (docs/observability.md): trace_id -> job_id for
+        # span ingestion from executor RPCs, and the cross-job counter
+        # aggregation the /api/metrics plane serves — both guarded by
+        # _lock like the job map they shadow. _obs_retained bounds the
+        # HEAVY per-job payloads (spans, op_metrics, stage_stats) across
+        # terminal jobs: the jobs dict itself has always kept light
+        # JobInfo records forever, but with the shipping collector
+        # default-on every completed task now adds per-operator records —
+        # unbounded retention would leak a long-lived scheduler dry.
+        self._traces: dict[str, str] = {}
+        self.obs_task_counters: dict[str, float] = {}
+        self._obs_retained: collections.deque = collections.deque()
+        self.obs_retained_jobs = 50
         self.state = None
         if state_backend is not None:
             from ballista_tpu.scheduler.persistent_state import (
@@ -404,33 +440,84 @@ class SchedulerServer:
         logical = SqlPlanner(self.provider).plan(stmt)
         return self.submit_logical(logical, session_id)
 
+    def _mint_trace(self, cfg) -> dict | None:
+        """Start a job trace when the session's ``ballista.tpu.trace`` is
+        not off (docs/observability.md): a fresh trace_id, the open root
+        span, and a list the pre-job-id plan/verify spans accumulate in.
+        None (no allocation anywhere downstream) when tracing is off."""
+        mode = cfg.trace()
+        if mode == "off":
+            return None
+        from ballista_tpu.obs import trace as obs_trace
+
+        obs_trace.configure(mode)
+        trace_id = obs_trace.new_trace_id()
+        return {
+            "trace_id": trace_id,
+            "root": obs_trace.start("job", trace_id),
+            "pre": [],
+        }
+
+    @staticmethod
+    def _trace_step(tctx: dict | None, name: str):
+        """Context manager recording one plan/verify span under the
+        pending job's root (no-op when tracing is off)."""
+        import contextlib
+
+        if tctx is None:
+            return contextlib.nullcontext()
+        from ballista_tpu.obs import trace as obs_trace
+
+        @contextlib.contextmanager
+        def step():
+            s = obs_trace.start(
+                name, tctx["trace_id"], tctx["root"].span_id
+            )
+            try:
+                yield s
+            except BaseException as e:
+                s.outcome = "error"
+                s.attrs["error"] = type(e).__name__
+                raise
+            finally:
+                obs_trace.finish(s, s.outcome)
+                tctx["pre"].append(s)
+
+        return step()
+
     def submit_logical(self, logical, session_id: str) -> str:
         cfg = self._session_config(session_id)
-        optimized = optimize(logical)
+        tctx = self._mint_trace(cfg)
         verify = cfg.verify_plans()
-        if verify:
-            # submission-time gate: reject inconsistent plans with a typed
-            # PlanVerificationError (naming the operator path) BEFORE any
-            # stage exists — the client sees it as the job-submission
-            # failure rather than an executor task failure minutes later
-            from ballista_tpu.analysis import verify_logical
+        with self._trace_step(tctx, "plan"):
+            optimized = optimize(logical)
+            if verify:
+                # submission-time gate: reject inconsistent plans with a
+                # typed PlanVerificationError (naming the operator path)
+                # BEFORE any stage exists — the client sees it as the
+                # job-submission failure rather than an executor task
+                # failure minutes later
+                with self._trace_step(tctx, "verify_logical"):
+                    from ballista_tpu.analysis import verify_logical
 
-            verify_logical(optimized)
-        # distributed=True inserts HashRepartitionExec exchange boundaries
-        # (honoring ballista.repartition.*) so the stage splitter can cut
-        # multi-partition hash shuffles (ref planner.rs:133-157)
-        physical = PhysicalPlanner(
-            self.provider,
-            cfg.default_shuffle_partitions(),
-            config=cfg,
-            distributed=True,
-            mesh_runtime=self._mesh_planning_runtime(cfg),
-        ).plan(optimized)
-        if verify:
-            from ballista_tpu.analysis import verify_physical
+                    verify_logical(optimized)
+            # distributed=True inserts HashRepartitionExec exchange
+            # boundaries (honoring ballista.repartition.*) so the stage
+            # splitter can cut multi-partition hash shuffles (ref
+            # planner.rs:133-157)
+            physical = PhysicalPlanner(
+                self.provider,
+                cfg.default_shuffle_partitions(),
+                config=cfg,
+                distributed=True,
+                mesh_runtime=self._mesh_planning_runtime(cfg),
+            ).plan(optimized)
+            if verify:
+                with self._trace_step(tctx, "verify_physical"):
+                    from ballista_tpu.analysis import verify_physical
 
-            verify_physical(physical)
-        return self.submit_physical(physical, session_id)
+                    verify_physical(physical)
+        return self.submit_physical(physical, session_id, trace=tctx)
 
     def _mesh_planning_runtime(self, cfg):
         """Planning-only mesh handle: when the session keeps collective
@@ -455,15 +542,179 @@ class SchedulerServer:
         )
         return _MeshPlanningHandle() if capable else None
 
-    def submit_physical(self, physical: ExecutionPlan, session_id: str) -> str:
+    def submit_physical(
+        self,
+        physical: ExecutionPlan,
+        session_id: str,
+        trace: dict | None = None,
+    ) -> str:
         job_id = generate_job_id()
+        if trace is None:
+            # direct physical submissions (tests, embedders) trace too
+            trace = self._mint_trace(self._session_config(session_id))
         with self._lock:
             job = JobInfo(job_id=job_id, session_id=session_id)
+            if trace is not None:
+                job.trace_id = trace["trace_id"]
+                root = trace["root"]
+                root.attrs["job_id"] = job_id
+                job.root_span_id = root.span_id
+                job.root_span = root
+                self._traces[job.trace_id] = job_id
+                for s in trace["pre"]:
+                    job.spans[s.span_id] = s
             self.jobs[job_id] = job
             if self.state is not None:
                 self.state.save_job(job)
         self.event_loop.post(JobSubmitted(job_id, physical))
         return job_id
+
+    # -- observability (docs/observability.md) -------------------------------
+    def _store_job_span(self, job: JobInfo, span) -> None:
+        """Keep one span in the job's bounded store (dict keyed span_id —
+        re-shipped duplicates dedup)."""
+        with self._lock:
+            if len(job.spans) < 20000:
+                job.spans.setdefault(span.span_id, span)
+
+    def _job_event(
+        self,
+        job: JobInfo,
+        name: str,
+        parent_id: str = "",
+        attrs: dict | None = None,
+    ) -> None:
+        """Record one scheduler-side point event on a traced job (no-op
+        for untraced jobs — the zero-overhead off path)."""
+        if not job.trace_id:
+            return
+        from ballista_tpu.obs import trace as obs_trace
+
+        s = obs_trace.event(
+            name,
+            trace_id=job.trace_id,
+            parent_id=parent_id or job.root_span_id,
+            attrs=attrs,
+        )
+        self._store_job_span(job, s)
+
+    def _stage_span_id(self, job: JobInfo, stage_id: int) -> str:
+        with self._lock:
+            s = job.stage_spans.get(stage_id)
+        return s.span_id if s is not None else job.root_span_id
+
+    def _open_stage_span(self, job: JobInfo, stage_id: int) -> None:
+        if not job.trace_id:
+            return
+        from ballista_tpu.obs import trace as obs_trace
+
+        with self._lock:
+            if stage_id in job.stage_spans:
+                return
+            job.stage_spans[stage_id] = obs_trace.start(
+                "stage",
+                job.trace_id,
+                job.root_span_id,
+                attrs={"stage_id": stage_id},
+            )
+
+    def _finish_stage_span(self, job: JobInfo, stage_id: int) -> None:
+        """Close a stage's span on first completion. The span OBJECT stays
+        in stage_spans: its span_id keeps parenting recompute-round task
+        attempts, so the recovery tree stays connected."""
+        if not job.trace_id:
+            return
+        from ballista_tpu.obs import trace as obs_trace
+
+        with self._lock:
+            s = job.stage_spans.get(stage_id)
+            if s is None or s.end_s:
+                return
+        obs_trace.finish(s)
+        self._store_job_span(job, s)
+
+    def ingest_spans(self, span_protos) -> None:
+        """Executor-shipped spans (poll/heartbeat/status RPCs) land in
+        their job's span store, matched by trace_id. Spans for unknown
+        traces (job torn down, foreign) are dropped — the ring already
+        has them for process-local debugging."""
+        if not span_protos:
+            return
+        from ballista_tpu.obs import trace as obs_trace
+
+        for p in span_protos:
+            s = obs_trace.span_from_proto(p)
+            with self._lock:
+                job_id = self._traces.get(s.trace_id)
+                job = self.jobs.get(job_id) if job_id is not None else None
+            if job is not None:
+                self._store_job_span(job, s)
+
+    def _ingest_task_metrics(self, job_id: str, stage_id: int,
+                             partition: int, status) -> None:
+        """Per-operator metrics shipped in a CompletedTask: stored per
+        (stage, partition) on the job, and summed into the cross-job
+        counter aggregation /api/metrics serves."""
+        if not status.completed.operator_metrics:
+            return
+        from ballista_tpu.obs import profile
+
+        records = profile.metrics_from_proto(
+            status.completed.operator_metrics
+        )
+        job = self._get_job(job_id)
+        with self._lock:
+            if job is not None:
+                job.op_metrics[(stage_id, partition)] = records
+            for r in records:
+                for k, v in r["counters"].items():
+                    if isinstance(v, (int, float)):
+                        self.obs_task_counters[k] = (
+                            self.obs_task_counters.get(k, 0) + v
+                        )
+
+    def job_stats(self, job_id: str) -> dict | None:
+        """Aggregated per-stage / per-partition stats for one job (the
+        /api/job/<id> payload body): task rows/bytes from the stage
+        bookkeeping (live) or the completion snapshot, overlaid with the
+        shipped per-operator metrics. None for unknown jobs."""
+        job = self._get_job(job_id)
+        if job is None:
+            return None
+        stages = job.stage_stats
+        if stages is None:
+            stages = self.stage_manager.job_stage_detail(job_id)
+        with self._lock:
+            op_metrics = {
+                f"{sid}/{part}": records
+                for (sid, part), records in sorted(job.op_metrics.items())
+            }
+        # key is "stage_stats", NOT "stages": the /api/job payload already
+        # carries a "stages" list (DAG edges + plan display) the status UI
+        # renders — clobbering it broke the expandable job rows
+        return {"stage_stats": stages, "operator_metrics": op_metrics}
+
+    def job_trace(self, job_id: str) -> list[dict] | None:
+        """The job's reassembled span tree, start-ordered (REST + chaos
+        assertions). None for unknown jobs; [] for untraced ones."""
+        job = self._get_job(job_id)
+        if job is None:
+            return None
+        with self._lock:
+            spans = sorted(job.spans.values(), key=lambda s: s.start_s)
+        return [
+            {
+                "trace_id": s.trace_id,
+                "span_id": s.span_id,
+                "parent_id": s.parent_id,
+                "name": s.name,
+                "start_s": round(s.start_s, 6),
+                "end_s": round(s.end_s, 6),
+                "status": s.outcome,
+                "attrs": {k: str(v) for k, v in sorted(s.attrs.items())},
+            }
+            for s in spans
+        ]
 
     # -- stage generation (ref query_stage_scheduler.rs:59-105) --------------
     def _generate_stages(self, job_id: str, plan: ExecutionPlan) -> None:
@@ -530,6 +781,7 @@ class SchedulerServer:
             if not self.stage_manager.is_completed_stage(job_id, u.stage_id)
         ]
         n_tasks = stage.input_partition_count
+        self._open_stage_span(job, stage_id)
         if unfinished:
             self.stage_manager.add_pending_stage(
                 job_id, stage_id, n_tasks, max_attempts=job.max_attempts
@@ -610,7 +862,9 @@ class SchedulerServer:
         job = self._get_job(job_id)
         if job is None:
             return
+        self._finish_stage_span(job, stage_id)
         deferred: list = []
+        promoted: list[int] = []
         for parent in self.stage_manager.parents_of(job_id, stage_id):
             # check+resolve+promote under the server lock, serialized
             # against _on_shuffle_lost: an invalidation racing this
@@ -637,6 +891,17 @@ class SchedulerServer:
                             job_id, parent
                         )
                     )
+                    promoted.append(parent)
+        for parent in promoted:
+            # recovery-shape visibility (docs/observability.md): the
+            # promote is the recovery's commit point — the chaos trace
+            # test asserts submit -> stage -> failed attempt -> recompute
+            # -> promote connect under one trace_id
+            self._job_event(
+                job, "promote",
+                parent_id=self._stage_span_id(job, parent),
+                attrs={"stage_id": parent, "after_stage": stage_id},
+            )
         for e in deferred:
             self.event_loop.post(e)
 
@@ -646,6 +911,15 @@ class SchedulerServer:
         job = self._get_job(event.job_id)
         if job is not None:
             job.total_retries += 1
+            self._job_event(
+                job, "task_retry",
+                parent_id=self._stage_span_id(job, event.stage_id),
+                attrs={
+                    "stage_id": event.stage_id,
+                    "partition": event.partition_id,
+                    "attempt": event.attempt,
+                },
+            )
         log.warning(
             "task %s/%s/%s requeued for attempt %d: %s",
             event.job_id, event.stage_id, event.partition_id,
@@ -687,6 +961,20 @@ class SchedulerServer:
                 self.stage_manager.demote_running_stage(job_id, consumer)
         rounds = self.stage_manager.stage_recomputes(job_id, map_stage_id)
         cap = self.stage_manager.stage_max_attempts(job_id, map_stage_id)
+        # recovery-shape visibility (docs/observability.md): the
+        # invalidate+recompute decision, parented to the producing stage's
+        # span so the kill -> invalidate -> recompute -> promote chain
+        # reads off the span tree
+        self._job_event(
+            job, "recompute",
+            parent_id=self._stage_span_id(job, map_stage_id),
+            attrs={
+                "stage_id": map_stage_id,
+                "executor_id": executor_id,
+                "reopened": len(reopened),
+                "round": rounds,
+            },
+        )
         log.warning(
             "shuffle output of %s/%s on executor %s lost; re-running %d map "
             "partitions (recompute round %d/%d)",
@@ -710,6 +998,47 @@ class SchedulerServer:
             self.event_loop.post(ReviveOffers())
         return True
 
+    def _close_job_trace(self, job: JobInfo, outcome: str = "ok") -> None:
+        """Finish whatever spans are still open (stage spans, root) and
+        store them — the job's span tree must be complete once the job
+        reaches a terminal status."""
+        if not job.trace_id:
+            return
+        from ballista_tpu.obs import trace as obs_trace
+
+        with self._lock:
+            open_spans = [
+                s for s in job.stage_spans.values() if not s.end_s
+            ]
+            root = job.root_span
+        for s in open_spans:
+            obs_trace.finish(s)
+            self._store_job_span(job, s)
+        if root is not None and not root.end_s:
+            obs_trace.finish(root, outcome)
+            self._store_job_span(job, root)
+
+    def _retain_job_obs(self, job: JobInfo) -> None:
+        """Enroll a terminal job in the bounded observability-retention
+        window: the newest ``obs_retained_jobs`` terminal jobs keep their
+        spans / operator metrics / stage-stats snapshot (served by
+        /api/job/<id>); older ones are stripped back to the light
+        JobInfo record the pre-observability scheduler kept."""
+        with self._lock:
+            self._obs_retained.append(job.job_id)
+            while len(self._obs_retained) > max(1, self.obs_retained_jobs):
+                old_id = self._obs_retained.popleft()
+                old = self.jobs.get(old_id)
+                if old is None:
+                    continue
+                old.spans.clear()
+                old.op_metrics.clear()
+                old.stage_spans.clear()
+                old.stage_stats = None
+                old.root_span = None
+                if old.trace_id:
+                    self._traces.pop(old.trace_id, None)
+
     def _on_job_finished(self, job_id: str) -> None:
         """Assemble CompletedJob locations (ref :370-388, :416-473)."""
         job = self._get_job(job_id)
@@ -726,6 +1055,12 @@ class SchedulerServer:
         job.status = "completed"
         if self.state is not None:
             self.state.save_job(job)
+        # observability: stats + trace snapshot BEFORE the stage teardown
+        # below — /api/job/<id> keeps serving the run's per-stage/
+        # per-partition stats after completion (docs/observability.md)
+        job.stage_stats = self.stage_manager.job_stage_detail(job_id)
+        self._close_job_trace(job, "ok")
+        self._retain_job_obs(job)
         # locations are snapshotted on the JobInfo; dropping the stage
         # bookkeeping zeroes the inflight count (KEDA's scale signal) and
         # stops fetch_schedulable_stage from ever seeing this job again
@@ -738,6 +1073,9 @@ class SchedulerServer:
             return
         job.status = "failed"
         job.error = error
+        job.stage_stats = self.stage_manager.job_stage_detail(job_id)
+        self._close_job_trace(job, "error")
+        self._retain_job_obs(job)
         # stage cleanup FIRST, and the write-through guarded: failure may
         # be the persistence backend itself, and skipping cleanup would
         # leave the failed job's PENDING tasks schedulable forever (push
@@ -878,24 +1216,44 @@ class SchedulerServer:
             self.event_loop.post(failure)
             return None
         cfg = self._session_config(job.session_id)
-        from ballista_tpu.config import BALLISTA_INTERNAL_TASK_ATTEMPT
+        from ballista_tpu.config import (
+            BALLISTA_INTERNAL_SPAN_PARENT,
+            BALLISTA_INTERNAL_TASK_ATTEMPT,
+            BALLISTA_INTERNAL_TRACE_ID,
+        )
 
+        props = [
+            pb.KeyValuePair(key=k, value=v)
+            for k, v in cfg.settings().items()
+        ] + [
+            # task-scoped (NOT session config; executors strip the
+            # ballista.internal. prefix before building BallistaConfig):
+            # the attempt number keys fault injection and retry logging
+            pb.KeyValuePair(
+                key=BALLISTA_INTERNAL_TASK_ATTEMPT, value=str(attempt)
+            )
+        ]
+        if job.trace_id:
+            # distributed tracing (docs/observability.md): the trace id
+            # plus the stage span as the task-attempt span's parent —
+            # a RETRY of a killed producer carries the SAME trace_id with
+            # a new attempt span, which is what the chaos trace test
+            # asserts
+            props += [
+                pb.KeyValuePair(
+                    key=BALLISTA_INTERNAL_TRACE_ID, value=job.trace_id
+                ),
+                pb.KeyValuePair(
+                    key=BALLISTA_INTERNAL_SPAN_PARENT,
+                    value=self._stage_span_id(job, stage_id),
+                ),
+            ]
         return pb.TaskDefinition(
             task_id=pb.PartitionId(
                 job_id=job_id, stage_id=stage_id, partition_id=partition
             ),
             plan=plan_bytes,
-            props=[
-                pb.KeyValuePair(key=k, value=v)
-                for k, v in cfg.settings().items()
-            ] + [
-                # task-scoped (NOT session config; executors strip the
-                # ballista.internal. prefix before building BallistaConfig):
-                # the attempt number keys fault injection and retry logging
-                pb.KeyValuePair(
-                    key=BALLISTA_INTERNAL_TASK_ATTEMPT, value=str(attempt)
-                )
-            ],
+            props=props,
             session_id=job.session_id,
         )
 
@@ -1070,6 +1428,10 @@ class SchedulerServer:
                     executor_id=st.completed.executor_id,
                     partitions=metas,
                 )
+                # per-operator metrics shipped home (docs/observability.md)
+                self._ingest_task_metrics(
+                    tid.job_id, tid.stage_id, tid.partition_id, st
+                )
             elif kind == "failed":
                 error = st.failed.error
                 # a ShuffleFetchError carries the SOURCE of the lost data;
@@ -1232,6 +1594,7 @@ class SchedulerGrpcServicer:
                     em.specification.task_slots,
                 )
             )
+        self.s.ingest_spans(list(request.spans))
         self.s.apply_task_statuses(list(request.task_status))
         result = pb.PollWorkResult()
         if request.can_accept_task:
@@ -1292,6 +1655,7 @@ class SchedulerGrpcServicer:
             request.executor_id,
             {kv.key: float(kv.value) for kv in request.metrics},
         )
+        self.s.ingest_spans(list(request.spans))
         # an executor the expiry sweep dropped (or a scheduler that restarted
         # without its registration) must re-register to get slots back
         reregister = (
@@ -1301,6 +1665,7 @@ class SchedulerGrpcServicer:
         return pb.HeartBeatResult(reregister=reregister)
 
     def UpdateTaskStatus(self, request, context):
+        self.s.ingest_spans(list(request.spans))
         self.s.apply_task_statuses(list(request.task_status))
         n_done = sum(
             1

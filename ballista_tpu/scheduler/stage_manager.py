@@ -641,6 +641,55 @@ class StageManager:
                 )
             return out
 
+    def job_stage_detail(self, job_id: str) -> list[dict]:
+        """Per-stage, per-task stats snapshot (docs/observability.md):
+        everything /api/job/<id> and EXPLAIN ANALYZE aggregation need —
+        task state, attempts, executor, and the written shuffle output's
+        rows/bytes/batches summed over the task's output partitions. The
+        scheduler overlays per-operator metrics (JobInfo.op_metrics) on
+        top; this stays a pure StageManager view so it can be snapshotted
+        before job teardown."""
+        with self._lock:
+            out = []
+            keys = sorted(k for k in self._stages if k[0] == job_id)
+            for key in keys:
+                _, sid = key
+                stage = self._stages[key]
+                state = (
+                    "completed" if key in self._completed
+                    else "running" if key in self._running
+                    else "pending"
+                )
+                tasks = []
+                for i, t in enumerate(stage.tasks):
+                    tasks.append(
+                        {
+                            "partition": i,
+                            "state": t.state.value,
+                            "attempts": t.attempts,
+                            "executor_id": t.executor_id,
+                            "output_rows": sum(
+                                m.num_rows for m in t.partitions
+                            ),
+                            "output_bytes": sum(
+                                m.num_bytes for m in t.partitions
+                            ),
+                            "output_batches": sum(
+                                m.num_batches for m in t.partitions
+                            ),
+                        }
+                    )
+                out.append(
+                    {
+                        "stage_id": sid,
+                        "state": state,
+                        "n_tasks": stage.n_tasks,
+                        "recomputes": stage.recomputes,
+                        "tasks": tasks,
+                    }
+                )
+            return out
+
     def has_running_tasks(self) -> bool:
         with self._lock:
             return any(

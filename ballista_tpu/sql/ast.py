@@ -172,6 +172,10 @@ class Explain:
     # (ballista_tpu/analysis/verifier.py) and print its report alongside
     # the plans instead of executing anything
     verify: bool = False
+    # EXPLAIN ANALYZE: EXECUTE the query with per-operator metering
+    # (ballista_tpu/obs/profile.py) and re-print the physical plan
+    # annotated with measured rows/bytes/elapsed per operator
+    analyze: bool = False
 
 
 Statement = (

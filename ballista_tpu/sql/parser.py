@@ -141,13 +141,17 @@ class Parser:
             verbose = self.accept_kw("verbose")
             # VERIFY is contextual (only meaningful right after
             # EXPLAIN [VERBOSE]), NOT a reserved word — `select verify
-            # from t` must keep parsing as an identifier
+            # from t` must keep parsing as an identifier. ANALYZE is
+            # already a lexer keyword, so it accepts as one.
             verify = False
             nt = self.peek()
             if nt.kind == Tok.IDENT and nt.value.lower() == "verify":
                 self.next()
                 verify = True
-            return ast.Explain(verbose, self.parse_query(), verify=verify)
+            analyze = not verify and self.accept_kw("analyze")
+            return ast.Explain(
+                verbose, self.parse_query(), verify=verify, analyze=analyze
+            )
         raise SqlError(f"unsupported statement starting with {t.value!r}")
 
     def parse_create(self) -> ast.CreateExternalTable:

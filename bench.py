@@ -225,6 +225,36 @@ def run_suite() -> dict:
         misses = counters.get("prefetch_misses", 0)
         if hits + misses:
             q["prefetch_hit_ratio"] = round(hits / (hits + misses), 3)
+        # observability overhead tracking (docs/observability.md):
+        # (1) tracing-off overhead must be NIL — with ballista.tpu.trace
+        # at its "off" default, no span may have been recorded by the
+        # timed passes above (the off path never mints a trace context,
+        # so the in-process ring stays empty — asserted, not hoped);
+        # (2) BENCH_PROFILE=1 additionally measures EXPLAIN ANALYZE-style
+        # per-operator capture: one instrumented warm pass, overhead
+        # reported per query.
+        from ballista_tpu.obs import trace as obs_trace
+
+        if cfg.trace() == "off":
+            n_spans = len(obs_trace.snapshot())
+            assert n_spans == 0, (
+                f"{qn}: tracing is off but {n_spans} spans were recorded "
+                "— the off path must cost (and allocate) nothing"
+            )
+            q["trace_off_spans"] = 0
+        if os.environ.get("BENCH_PROFILE"):
+            from ballista_tpu.obs import profile as obs_profile
+
+            # `phys` is the instance the physical-plan cache returns for
+            # this (query, config, data) key, so the timed pass below
+            # re-executes exactly this instrumented tree (cache hits
+            # reset metrics but keep the metering wrappers)
+            obs_profile.instrument_plan(phys)
+            t0 = time.time()
+            _collect_with_plan(ctx, sql)
+            profiled = time.time() - t0
+            q["profile_capture_s"] = round(profiled, 4)
+            q["profile_overhead_s"] = round(profiled - min(warms), 4)
         if prefetch_on and counters.get("stream_slices", 0) > 1:
             # prefetch A/B on streamed queries: same data, same run, depth
             # 0 — the acceptance signal that compute/IO overlap pays
