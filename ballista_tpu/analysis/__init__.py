@@ -35,6 +35,20 @@ submission/collection time — run together via
   committed field-number ledger (``proto/field_numbers.json``).
 - :mod:`ballista_tpu.analysis.configlint` — config-key & env-var
   registry closure with the generated ``docs/config.md`` table.
+- :mod:`ballista_tpu.analysis.eqlint` — the no-uncertified-mutation
+  closure over physical plans: direct writes to structural plan fields
+  outside the certified rewrite API (``ballista_tpu/rewrite.py``) are
+  findings, so every plan mutation carries a machine-checkable
+  equivalence certificate.
+- :mod:`ballista_tpu.analysis.detlint` — determinism lint over the data
+  plane and plan pipeline (unordered set iteration, undeclared RNG,
+  wall-clock reads in kernels, completion-order-dependent merges), with
+  its runtime counterpart in :mod:`ballista_tpu.analysis.replay`
+  (``BALLISTA_REPLAY_WITNESS=1``): canonical content hashes proving
+  retries, lineage recomputes, and certified rewrites replay bit-exact.
+
+Suppression budgets for all AST analyzers live in the single ledger
+:mod:`ballista_tpu.analysis.budget`.
 """
 
 from ballista_tpu.errors import PlanVerificationError  # noqa: F401

@@ -184,6 +184,7 @@ class BallistaContext(TpuContext):
         # this client-side result fetch.) Arrow tables share buffers, so
         # flattening to batches for the single from_batches below copies
         # nothing.
+        from ballista_tpu.analysis import replay
         from ballista_tpu.executor.reader import fetch_partition_table
 
         batches = []
@@ -198,6 +199,15 @@ class BallistaContext(TpuContext):
                 path=loc_p.path,
             )
             t = fetch_partition_table(loc)
+            if replay.enabled():
+                # replay witness: every final result partition records a
+                # canonical content hash — the client-visible half of the
+                # bit-exactness invariant (docs/fault_tolerance.md)
+                replay.record(
+                    "result",
+                    (loc.job_id, loc.stage_id, loc.partition),
+                    replay.canonical_hash(t),
+                )
             if t.num_rows:
                 batches.extend(t.to_batches())
         if not batches:

@@ -177,6 +177,14 @@ ENV_REGISTRY: tuple[EnvEntry, ...] = (
         "docs/analysis.md",
     ),
     EnvEntry(
+        "BALLISTA_REPLAY_WITNESS", "0|1", "0",
+        "Runtime replay witness: committed shuffle outputs and final "
+        "result partitions record canonical content hashes; retries, "
+        "lineage recomputes, and certified rewrites must re-record "
+        "identical hashes (analysis/replay.py)",
+        "docs/fault_tolerance.md",
+    ),
+    EnvEntry(
         "BALLISTA_TPU_JAX_CACHE", "path|off", "~/.cache/ballista_tpu_jax",
         "Persistent XLA compilation cache directory; 'off' disables the "
         "cache machinery entirely",

@@ -33,6 +33,7 @@ from ballista_tpu.exec.base import (
     ExecutionPlan,
     TaskContext,
     UnknownPartitioning,
+    replace_children,
 )
 from ballista_tpu.exec.joins import HashJoinExec
 from ballista_tpu.exec.pipeline import CoalescePartitionsExec
@@ -176,7 +177,7 @@ class DistributedPlanner:
                 left, right, plan.on, plan.join_type, plan.filter
             )
 
-        return _with_children(plan, children)
+        return replace_children(plan, children)
 
     def _materialize_collected(
         self, job_id: str, side: ExecutionPlan, stages: list[QueryStage]
@@ -193,29 +194,6 @@ class DistributedPlanner:
             side.output_partitioning().n,
             1,
         )
-
-
-def _with_children(
-    plan: ExecutionPlan, children: list[ExecutionPlan]
-) -> ExecutionPlan:
-    """Rebuild an operator with new children (physical nodes are mutable
-    drivers; swap in place when identity is unchanged)."""
-    old = plan.children()
-    if len(old) != len(children):
-        raise PlanError("child arity mismatch")
-    if all(a is b for a, b in zip(old, children)):
-        return plan
-    # mutate the known child slots
-    if hasattr(plan, "input") and len(children) == 1:
-        plan.input = children[0]
-        return plan
-    if hasattr(plan, "left") and len(children) == 2:
-        plan.left, plan.right = children
-        return plan
-    if hasattr(plan, "inputs"):
-        plan.inputs = list(children)
-        return plan
-    raise PlanError(f"cannot rebuild {type(plan).__name__} with new children")
 
 
 def find_unresolved_shuffles(
@@ -267,7 +245,7 @@ def remove_unresolved_shuffles(
     ]
     if all(a is b for a, b in zip(plan.children(), children)):
         return plan  # no placeholder below: share the subtree
-    return _with_children(copy.copy(plan), children)
+    return replace_children(copy.copy(plan), children)
 
 
 def resolve_shuffles_eager(plan: ExecutionPlan, job_id: str) -> ExecutionPlan:
@@ -293,4 +271,4 @@ def resolve_shuffles_eager(plan: ExecutionPlan, job_id: str) -> ExecutionPlan:
     children = [resolve_shuffles_eager(c, job_id) for c in plan.children()]
     if all(a is b for a, b in zip(plan.children(), children)):
         return plan
-    return _with_children(copy.copy(plan), children)
+    return replace_children(copy.copy(plan), children)

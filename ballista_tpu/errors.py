@@ -56,6 +56,27 @@ class PlanVerificationError(PlanError):
         super().__init__("; ".join(parts))
 
 
+class RewriteRejected(PlanError):
+    """A certified plan rewrite failed certificate validation and was NOT
+    applied (ballista_tpu/rewrite.py, docs/analysis.md). Carries the
+    failing certificate ``clause`` name plus the stage ids the rejected
+    rewrite would have touched, so callers (the scheduler's rewrite
+    acceptance gate, AQE policies) can log and fall back to the pristine
+    stage template with a machine-readable reason. Deterministic:
+    re-validating the same rewrite re-derives the same rejection."""
+
+    def __init__(
+        self,
+        message: str,
+        clause: str = "",
+        stage_ids: tuple = (),
+    ):
+        self.clause = clause
+        self.stage_ids = tuple(stage_ids)
+        tag = f"[rewrite-rejected clause={clause or 'unknown'}]"
+        super().__init__(f"{tag} {message}")
+
+
 class SchemaError(BallistaError):
     """Schema mismatch or unknown column."""
 
@@ -160,6 +181,7 @@ NON_RETRYABLE_ERROR_TYPES = frozenset(
     {
         "PlanVerificationError",
         "PlanError",
+        "RewriteRejected",
         "SqlError",
         "SchemaError",
         "ConfigError",

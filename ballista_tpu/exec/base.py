@@ -527,6 +527,37 @@ class ExecutionPlan:
         return "\n".join(lines)
 
 
+def replace_children(
+    plan: ExecutionPlan, children: list["ExecutionPlan"]
+) -> ExecutionPlan:
+    """THE sanctioned child-rebind primitive: rebuild an operator with new
+    children, mutating the known child slots in place when identity
+    changed. Every structural plan mutation in the tree must route through
+    here or through the certified rewrite API (ballista_tpu/rewrite.py) —
+    the eqlint no-uncertified-mutation rule (analysis/eqlint.py) flags
+    direct plan-field writes anywhere else. Callers that need
+    copy-on-write semantics pass a ``copy.copy`` of ``plan``
+    (distributed_plan.remove_unresolved_shuffles, rewrite._rebuild)."""
+    from ballista_tpu.errors import PlanError
+
+    old = plan.children()
+    if len(old) != len(children):
+        raise PlanError("child arity mismatch")
+    if all(a is b for a, b in zip(old, children)):
+        return plan
+    # mutate the known child slots
+    if hasattr(plan, "input") and len(children) == 1:
+        plan.input = children[0]
+        return plan
+    if hasattr(plan, "left") and len(children) == 2:
+        plan.left, plan.right = children
+        return plan
+    if hasattr(plan, "inputs"):
+        plan.inputs = list(children)
+        return plan
+    raise PlanError(f"cannot rebuild {type(plan).__name__} with new children")
+
+
 def execute_to_batches(
     plan: ExecutionPlan, ctx: TaskContext
 ) -> list[DeviceBatch]:

@@ -324,6 +324,25 @@ class Executor:
             )
         self._plan_cache.update(attempt_cache)
         self._hints.save_if_changed(self._capacity_hint, self._plan_cache)
+        from ballista_tpu.analysis import replay
+
+        if replay.enabled():
+            # replay witness (docs/fault_tolerance.md): content-hash every
+            # COMMITTED (stage, map task, output partition) — a retry,
+            # lineage recompute, or certified rewrite re-recording the
+            # same key must hash identically. Only successful attempts
+            # reach here, so failed attempts' partial files never record.
+            for m in out:
+                replay.record(
+                    "shuffle",
+                    (
+                        task.task_id.job_id,
+                        task.task_id.stage_id,
+                        task.task_id.partition_id,
+                        m.partition_id,
+                    ),
+                    replay.hash_file(m.path),
+                )
         op_metrics = collector.record_stage(
             task.task_id.job_id, task.task_id.stage_id,
             task.task_id.partition_id, plan,

@@ -242,6 +242,7 @@ def scheduler_state(server) -> dict:
             # assert on these)
             "retries": j.total_retries,
             "recomputes": j.total_recomputes,
+            "rewrites": j.total_rewrites,
             # per-stage DAG state + task counts (the reference UI's job
             # detail view; ref ballista/ui job/stage tables)
             "stages": server.stage_manager.job_stage_summary(j.job_id),
@@ -290,6 +291,10 @@ def job_detail(server, job_id: str) -> dict | None:
             "stages": stages,
             "retries": job.total_retries,
             "recomputes": job.total_recomputes,
+            # certified-rewrite visibility (docs/analysis.md): accepted
+            # template swaps + certificate rejections
+            "rewrites": job.total_rewrites,
+            "rewrite_rejects": job.total_rewrite_rejects,
             "trace_id": job.trace_id,
         }
     # stats/trace aggregation takes the server lock itself — outside the

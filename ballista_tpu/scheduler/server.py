@@ -31,6 +31,7 @@ from ballista_tpu.distributed_plan import (
 )
 from ballista_tpu.errors import (
     PlanError,
+    RewriteRejected,
     error_is_retryable,
     parse_shuffle_fetch_error,
 )
@@ -66,7 +67,21 @@ log = logging.getLogger(__name__)
 
 def generate_job_id() -> str:
     """7-char alnum ids (ref grpc.rs:546-553)."""
-    return "".join(random.choices(string.ascii_lowercase + string.digits, k=7))
+    return "".join(  # detlint: nondet=id-minting
+        random.choices(string.ascii_lowercase + string.digits, k=7)
+    )
+
+
+def _stage_dependencies(stages) -> dict[int, set[int]]:
+    """child stage id -> parent stage ids (parents consume the child),
+    recomputed from placeholders — shared by initial stage generation and
+    the certified-rewrite swap (exchange injection/removal changes the
+    edge set)."""
+    deps: dict[int, set[int]] = {}
+    for stage in stages:
+        for u in find_unresolved_shuffles(stage.plan):
+            deps.setdefault(u.stage_id, set()).add(stage.stage_id)
+    return deps
 
 
 class _MeshPlanningHandle:
@@ -120,6 +135,11 @@ class JobInfo:
     max_attempts: int = 3
     total_retries: int = 0
     total_recomputes: int = 0
+    # certified plan rewrites (ballista_tpu/rewrite.py): accepted swaps of
+    # stage templates + certificate-validation rejections (visibility for
+    # REST and the chaos suites; both 0 on a non-adaptive run)
+    total_rewrites: int = 0
+    total_rewrite_rejects: int = 0
     # observability (docs/observability.md). trace_id is minted at
     # submission when the session's ballista.tpu.trace is not "off";
     # empty trace_id IS the zero-overhead off path (no span is ever
@@ -418,7 +438,7 @@ class SchedulerServer:
                 if settings:
                     self.sessions[session_id] = BallistaConfig(settings)
                 return session_id
-            new_id = "".join(
+            new_id = "".join(  # detlint: nondet=id-minting
                 random.choices(string.ascii_lowercase + string.digits, k=16)
             )
             self.sessions[new_id] = (
@@ -739,11 +759,9 @@ class SchedulerServer:
             return
         job.max_attempts = cfg.task_max_attempts()
         job.eager = cfg.eager_shuffle()
-        deps: dict[int, set[int]] = {}
+        deps = _stage_dependencies(stages)
         for stage in stages:
             job.stages[stage.stage_id] = stage
-            for u in find_unresolved_shuffles(stage.plan):
-                deps.setdefault(u.stage_id, set()).add(stage.stage_id)
         job.final_stage_id = stages[-1].stage_id
         job.dependencies = deps
         self.stage_manager.add_final_stage(job_id, job.final_stage_id)
@@ -865,7 +883,11 @@ class SchedulerServer:
         self._finish_stage_span(job, stage_id)
         deferred: list = []
         promoted: list[int] = []
-        for parent in self.stage_manager.parents_of(job_id, stage_id):
+        # sorted: parents_of returns a set, and promote/event order should
+        # not vary with hash seed (detlint unordered-iteration hardening —
+        # determinism of the recovery event sequence is what the chaos
+        # trace assertions read)
+        for parent in sorted(self.stage_manager.parents_of(job_id, stage_id)):
             # check+resolve+promote under the server lock, serialized
             # against _on_shuffle_lost: an invalidation racing this
             # resolve would otherwise let it bake EMPTY location lists
@@ -954,8 +976,8 @@ class SchedulerServer:
             if not reopened:
                 return False
             job.total_recomputes += 1
-            for consumer in self.stage_manager.parents_of(
-                job_id, map_stage_id
+            for consumer in sorted(  # set-ordered walk: see _on_stage_finished
+                self.stage_manager.parents_of(job_id, map_stage_id)
             ):
                 job.resolved_plan_bytes.pop(consumer, None)
                 self.stage_manager.demote_running_stage(job_id, consumer)
@@ -997,6 +1019,161 @@ class SchedulerServer:
         if self.policy == TaskSchedulingPolicy.PUSH_STAGED:
             self.event_loop.post(ReviveOffers())
         return True
+
+    # -- certified plan rewrites (ballista_tpu/rewrite.py) -------------------
+    def apply_certified_rewrite(self, job_id: str, op):
+        """The ONLY sanctioned way to change a running job's stage
+        templates (docs/analysis.md): apply a typed rewrite op over THIS
+        server's pristine templates under the server lock — the
+        certificate is derived here, never accepted from a caller —
+        enforce the runtime precondition (every touched stage fully
+        pending), and only then swap templates + bookkeeping atomically.
+        Any failure raises the typed :class:`RewriteRejected` carrying
+        the failing clause and leaves the pristine templates untouched —
+        the job proceeds on the unrewritten plan. Returns the validated
+        certificate.
+
+        This is the seam the AQE policy layer (ROADMAP) plugs into: it
+        decides WHAT to rewrite from runtime stats; this method decides
+        whether the rewrite is provably safe."""
+        from ballista_tpu import rewrite as rewrite_mod
+        from ballista_tpu.testing import faults
+
+        job = self._get_job(job_id)
+        if job is None or job.status != "running":
+            raise RewriteRejected(
+                f"job {job_id} is not running", clause="job-state"
+            )
+        deferred: list = []
+        try:
+            with self._lock:
+                old_stages = list(job.stages.values())
+                result = rewrite_mod.apply_rewrite(
+                    old_stages, op, job_id=job_id
+                )
+                inj = faults.active()
+                if inj is not None:
+                    # chaos: the certificate-validation failure path
+                    # (rewrite_reject rules raise RewriteRejected here)
+                    inj.on_rewrite_validate(
+                        job_id, getattr(op, "stage_id", -1)
+                    )
+                # the certificate was derived HERE, under the lock, from
+                # this server's own pristine templates (apply_rewrite
+                # certifies and raises on any failing clause) — there is
+                # no producer-supplied copy to distrust
+                cert = result.certificate
+                new_by = {s.stage_id: s for s in result.stages}
+                touched = cert.rewritten_stages + cert.added_stages
+                err = self.stage_manager.rebind_stages_for_rewrite(
+                    job_id,
+                    affected={
+                        sid: new_by[sid].input_partition_count
+                        for sid in cert.rewritten_stages
+                    },
+                    removed=cert.removed_stages,
+                    added={
+                        sid: new_by[sid].input_partition_count
+                        for sid in cert.added_stages
+                    },
+                    deps=_stage_dependencies(result.stages),
+                    max_attempts=job.max_attempts,
+                )
+                if err is not None:
+                    raise RewriteRejected(err, clause="runtime-state")
+                # accepted: swap the pristine templates + invalidate every
+                # cached resolution of a touched stage (eager bytes too —
+                # they are location-free but template-derived)
+                job.stages = {s.stage_id: s for s in result.stages}
+                job.dependencies = _stage_dependencies(result.stages)
+                for sid in touched + cert.removed_stages:
+                    job.resolved_plan_bytes.pop(sid, None)
+                    job.eager_plan_bytes.pop(sid, None)
+                job.total_rewrites += 1
+                from ballista_tpu import rewrite as _rw
+                from ballista_tpu.analysis import replay
+
+                if replay.enabled():
+                    # the witness must not compare across content that
+                    # legitimately changes: re-bucketed stages always;
+                    # for MULTISET_EXACT rewrites also every touched
+                    # stage and its transitive consumers (float folds
+                    # re-associate downstream — see rewrite.BIT_EXACT)
+                    forget = set(cert.bucket_changed_stages)
+                    if cert.exactness != _rw.BIT_EXACT:
+                        forget |= set(touched)
+                    frontier = set(forget)
+                    while frontier:
+                        frontier = {
+                            parent
+                            for child in frontier
+                            for parent in job.dependencies.get(
+                                child, set()
+                            )
+                        } - forget
+                        forget |= frontier
+                    for sid in sorted(forget):
+                        replay.forget_stage(job_id, sid)
+                if self.state is not None:
+                    for sid in touched:
+                        self.state.save_stage_plan(
+                            job_id, sid, new_by[sid].plan
+                        )
+                # re-promote touched stages whose dependencies are already
+                # complete (they were forced PENDING by the rebind; nothing
+                # else re-promotes them until a dependency finishes)
+                for sid in sorted(touched):
+                    if not self.stage_manager.is_pending_stage(job_id, sid):
+                        continue
+                    unresolved = find_unresolved_shuffles(
+                        job.stages[sid].plan
+                    )
+                    if all(
+                        self.stage_manager.is_completed_stage(
+                            job_id, u.stage_id
+                        )
+                        for u in unresolved
+                    ):
+                        self._resolve_stage(job_id, sid)
+                        deferred.extend(
+                            self.stage_manager.promote_pending_stage(
+                                job_id, sid
+                            )
+                        )
+        except RewriteRejected as e:
+            with self._lock:
+                # same discipline as the accepted-path counter: REST and
+                # chaos assertions read these, and an unlocked
+                # read-modify-write can drop concurrent increments
+                job.total_rewrite_rejects += 1
+            self._job_event(
+                job, "rewrite_reject",
+                attrs={"op": op.describe(), "clause": e.clause},
+            )
+            log.warning(
+                "certified rewrite REJECTED for %s: %s", job_id, e
+            )
+            raise
+        # events post after the lock: the queue is bounded (racelint
+        # blocking-under-lock), and every accepted rewrite may unlock work
+        self._job_event(
+            job, "rewrite",
+            attrs={
+                "op": op.describe(),
+                "rewritten": list(cert.rewritten_stages),
+                "added": list(cert.added_stages),
+                "removed": list(cert.removed_stages),
+            },
+        )
+        log.warning(
+            "certified rewrite ACCEPTED for %s: %s (%s)",
+            job_id, op.describe(), cert.summary(),
+        )
+        for e in deferred:
+            self.event_loop.post(e)
+        if self.policy == TaskSchedulingPolicy.PUSH_STAGED:
+            self.event_loop.post(ReviveOffers())
+        return cert
 
     def _close_job_trace(self, job: JobInfo, outcome: str = "ok") -> None:
         """Finish whatever spans are still open (stage spans, root) and

@@ -277,7 +277,7 @@ class StageManager:
         completes."""
         with self._lock:
             candidates = []
-            for key in self._pending:
+            for key in self._pending:  # detlint: nondet=placement
                 job_id, stage_id = key
                 if job_id not in eager_jobs:
                     continue
@@ -305,7 +305,9 @@ class StageManager:
                     candidates.append(key)
             if not candidates:
                 return None
-            job_id, stage_id = random.choice(candidates)
+            job_id, stage_id = random.choice(  # detlint: nondet=placement
+                candidates
+            )
             pending = self.fetch_pending_tasks(
                 job_id, stage_id, 1, executor_id=executor_id
             )
@@ -356,7 +358,7 @@ class StageManager:
         with self._lock:
             candidates = [
                 key
-                for key in self._running
+                for key in self._running  # detlint: nondet=placement
                 if any(
                     t.state == TaskState.PENDING
                     for t in self._stages[key].tasks
@@ -364,7 +366,7 @@ class StageManager:
             ]
             if not candidates:
                 return None
-            return random.choice(candidates)
+            return random.choice(candidates)  # detlint: nondet=placement
 
     # -- status updates ------------------------------------------------------
     def update_task_status(
@@ -529,6 +531,81 @@ class StageManager:
                     self._completed.discard(key)
                     self._running.add(key)
         return out
+
+    def rebind_stages_for_rewrite(
+        self,
+        job_id: str,
+        affected: dict[int, int],
+        removed: tuple[int, ...],
+        added: dict[int, int],
+        deps: dict[int, set[int]],
+        max_attempts: int = 3,
+    ) -> str | None:
+        """Atomically re-register bookkeeping for a certified rewrite
+        (SchedulerServer.apply_certified_rewrite): ``affected`` maps every
+        rewritten stage id to its (possibly changed) task count,
+        ``removed``/``added`` are the exchange-elimination/-injection
+        deltas, ``deps`` is the job's full recomputed dependency map.
+
+        Runtime precondition, checked under the lock before anything
+        changes: every touched stage must be fully PENDING — no task
+        running or completed, no completed stage. A stage with progress
+        holds results computed against the OLD template (a producer's
+        files already bucketed the old way, a consumer task mid-fetch),
+        and swapping under it is exactly the uncertified mutation this
+        API exists to prevent. Returns an error string on violation
+        (nothing mutated — the caller rejects and keeps the pristine
+        templates); None on success. Rewritten stages land PENDING (the
+        caller re-resolves and promotes the ones whose deps are already
+        complete); ``recomputes`` carries over so lineage-recovery
+        boundedness survives a rewrite."""
+        with self._lock:
+            for sid in list(affected) + list(removed):
+                key = (job_id, sid)
+                stage = self._stages.get(key)
+                if stage is None:
+                    return f"stage {sid} has no bookkeeping to rebind"
+                if key in self._completed:
+                    return f"stage {sid} already completed"
+                busy = [
+                    t.state.value
+                    for t in stage.tasks
+                    if t.state != TaskState.PENDING
+                ]
+                if busy:
+                    return (
+                        f"stage {sid} has {len(busy)} non-pending tasks "
+                        f"({sorted(set(busy))}); rewrites require a fully "
+                        "pending stage"
+                    )
+            for sid, n_tasks in affected.items():
+                key = (job_id, sid)
+                old = self._stages[key]
+                fresh = Stage(
+                    job_id, sid, n_tasks, max_attempts=old.max_attempts
+                )
+                fresh.recomputes = old.recomputes
+                self._stages[key] = fresh
+                self._running.discard(key)
+                self._pending.add(key)
+            for sid in removed:
+                key = (job_id, sid)
+                self._stages.pop(key, None)
+                self._running.discard(key)
+                self._pending.discard(key)
+            for sid, n_tasks in added.items():
+                key = (job_id, sid)
+                self._stages[key] = Stage(
+                    job_id, sid, n_tasks, max_attempts=max(1, max_attempts)
+                )
+                self._pending.add(key)
+            # dependency map: wholesale replacement for this job — stale
+            # entries (including removed stages') all drop here
+            for key in [k for k in self._dependencies if k[0] == job_id]:
+                self._dependencies.pop(key)
+            for child, parents in deps.items():
+                self._dependencies[(job_id, child)] = set(parents)
+            return None
 
     def stages_with_outputs_of(
         self, executor_ids: set[str]

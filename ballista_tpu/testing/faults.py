@@ -47,6 +47,11 @@ Injection points (all default-off, one ``is None`` check when disabled):
   batch; a matching ``producer_kill`` breaks the stream after
   ``after_batches`` batches already reached the consumer (the
   producer-dies-mid-stream recovery shape, docs/shuffle.md).
+- ``on_rewrite_validate`` — the scheduler's certified-rewrite acceptance
+  gate; a matching ``rewrite_reject`` (keyed by job/stage, optional
+  ``clause``) fails certificate validation with the typed
+  RewriteRejected, so the reject + fall-back-to-pristine-template path
+  is reachable and testable (docs/analysis.md).
 
 Normal runs must never be poisoned by a stray env var: tests/conftest.py
 strips ``BALLISTA_FAULTS*`` from the environment and asserts the harness
@@ -74,6 +79,7 @@ POINTS = (
     "fetch_slow",
     "heartbeat_blackout",
     "producer_kill",
+    "rewrite_reject",
 )
 
 
@@ -229,6 +235,27 @@ class FaultInjector:
             if self._fire(idx, r, "producer_kill", key):
                 raise InjectedFault(
                     f"injected producer kill mid-stream at {key}"
+                )
+
+    def on_rewrite_validate(self, job_id: str, stage_id: int) -> None:
+        """Scheduler certificate-validation gate
+        (SchedulerServer.apply_certified_rewrite): a matching
+        ``rewrite_reject`` rule fails validation with the typed
+        RewriteRejected the real gate raises, exercising the
+        reject-and-fall-back-to-pristine-template path (the job must
+        still complete, on the unrewritten plan). Keyed by (job, stage);
+        ``partition``/``attempt`` do not apply."""
+        key = (job_id, stage_id)
+        for idx, r in self._matching(
+            "rewrite_reject", job_id, stage_id, None, None
+        ):
+            if self._fire(idx, r, "rewrite_reject", key):
+                from ballista_tpu.errors import RewriteRejected
+
+                raise RewriteRejected(
+                    f"injected certificate rejection at {key}",
+                    clause=r.get("clause", "injected"),
+                    stage_ids=(stage_id,),
                 )
 
     def heartbeat_suppressed(self, executor_id: str) -> bool:

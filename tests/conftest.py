@@ -29,7 +29,11 @@ for _k in ("BALLISTA_FAULTS", "BALLISTA_FAULTS_SEED"):
 # that chaos/hygiene tests enable in SUBPROCESS envs; leaked into the
 # runner they would instrument every test's locks/channels and make
 # tier-1 timing (and witness assertions) nondeterministic.
-for _k in ("BALLISTA_LOCK_WITNESS", "BALLISTA_RESOURCE_WITNESS"):
+for _k in (
+    "BALLISTA_LOCK_WITNESS",
+    "BALLISTA_RESOURCE_WITNESS",
+    "BALLISTA_REPLAY_WITNESS",
+):
     os.environ.pop(_k, None)
 
 # Hermetic plan-hint persistence: without this, every in-test TpuContext/

@@ -1,0 +1,45 @@
+"""THE suppression-budget test (analysis/budget.py).
+
+planlint/racelint/lifelint each used to carry their own ``<= 5``
+assertion in their own file — three places a budget could silently grow.
+This single test walks the shared ledger instead: every AST analyzer is
+registered, every budget is enforced here and nowhere else, and growing
+any budget means editing analysis/budget.py in plain sight of this
+file."""
+
+from ballista_tpu.analysis import budget
+
+
+def test_every_analyzer_within_budget():
+    ledger = budget.ledger()
+    assert set(ledger) == {
+        "jaxlint", "racelint", "lifelint", "eqlint", "detlint"
+    }
+    for name, row in ledger.items():
+        assert row["used"] <= row["budget"], (
+            f"{name}: {row['used']} suppressions > budget {row['budget']}"
+        )
+
+
+def test_current_counts_pinned():
+    """The live counts, pinned: a NEW suppression anywhere shows up as a
+    diff to this test plus its in-code justification comment."""
+    used = {k: v["used"] for k, v in budget.ledger().items()}
+    assert used == {
+        "jaxlint": 0,
+        # the documented double-checked fast path in testing/faults.py
+        "racelint": 1,
+        "lifelint": 0,
+        "eqlint": 0,
+        "detlint": 0,
+    }, used
+
+
+def test_budgets_are_uniform_and_small():
+    assert set(budget.BUDGETS.values()) == {5}
+
+
+def test_check_message_names_the_ledger():
+    assert budget.check("eqlint", 5) is None
+    msg = budget.check("eqlint", 6)
+    assert msg is not None and "analysis/budget.py" in msg

@@ -1,0 +1,151 @@
+"""detlint (analysis/detlint.py): determinism lint acceptance.
+
+Clean tree + every rule family rejects its seeded mutation + the
+declared-nondeterminism ledger is pinned (a new deliberate nondet site
+must show up in this diff, like lifelint's ownership transfers)."""
+
+from ballista_tpu.analysis import detlint
+
+
+def rules_of(diags):
+    return [d.rule for d in diags]
+
+
+def test_tree_is_clean():
+    diags = detlint.lint_paths()
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
+def test_declared_nondet_sites_pinned():
+    sites = detlint.nondet_sites()
+    # scheduler placement picks + id minting are the ONLY declared
+    # nondeterminism in the tree; anything new must be justified here
+    assert sorted({(f.split("/")[-1], why) for f, _, why in sites}) == [
+        ("server.py", "id-minting"),
+        ("stage_manager.py", "placement"),
+    ], sites
+    assert len(sites) == 6, sites
+
+
+def test_unordered_set_iteration_rejected():
+    src = (
+        "def route(parts):\n"
+        "    s = {p for p in parts}\n"
+        "    out = []\n"
+        "    for p in s:\n"
+        "        out.append(p)\n"
+        "    return out + list(set(parts))\n"
+    )
+    diags = detlint.lint_source(src, "ballista_tpu/exec/x.py")
+    assert rules_of(diags) == ["unordered-iteration"] * 2
+
+
+def test_set_typed_attribute_and_annotation_inference():
+    src = (
+        "class M:\n"
+        "    def __init__(self):\n"
+        "        self.pending = set()\n"
+        "    def drain(self):\n"
+        "        return [k for k in self.pending]\n"
+        "def parents() -> set[int]:\n"
+        "    return set()\n"
+        "def walk():\n"
+        "    for p in parents():\n"
+        "        print(p)\n"
+    )
+    diags = detlint.lint_source(src, "ballista_tpu/scheduler/x.py")
+    assert rules_of(diags) == ["unordered-iteration"] * 2
+
+
+def test_sorted_wrapping_accepts():
+    src = (
+        "def route(parts):\n"
+        "    s = set(parts)\n"
+        "    return [p for p in sorted(s)]\n"
+    )
+    assert detlint.lint_source(src, "ballista_tpu/exec/x.py") == []
+
+
+def test_undeclared_rng_rejected_and_nondet_marker_accepts():
+    bad = "import random\ndef pick(xs):\n    return random.choice(xs)\n"
+    diags = detlint.lint_source(bad, "ballista_tpu/scheduler/x.py")
+    assert rules_of(diags) == ["undeclared-rng"]
+    ok = (
+        "import random\n"
+        "def pick(xs):\n"
+        "    return random.choice(xs)  # detlint: nondet=placement\n"
+    )
+    assert detlint.lint_source(ok, "ballista_tpu/scheduler/x.py") == []
+    # jax.random's explicit-key API is deterministic by construction
+    jx = "import jax\ndef f(k):\n    return jax.random.uniform(k)\n"
+    assert detlint.lint_source(jx, "ballista_tpu/ops/x.py") == []
+
+
+def test_wallclock_rejected_in_dataplane_only():
+    src = "import time\ndef stamp():\n    return time.time()\n"
+    assert rules_of(
+        detlint.lint_source(src, "ballista_tpu/exec/x.py")
+    ) == ["wallclock-in-dataplane"]
+    assert rules_of(
+        detlint.lint_source(src, "ballista_tpu/ops/x.py")
+    ) == ["wallclock-in-dataplane"]
+    # control-plane timestamps (heartbeats, TTLs, deadlines) are fine
+    assert detlint.lint_source(src, "ballista_tpu/scheduler/x.py") == []
+    # perf_counter (the Metrics timer primitive) is always fine
+    pc = "import time\ndef t():\n    return time.perf_counter()\n"
+    assert detlint.lint_source(pc, "ballista_tpu/exec/x.py") == []
+
+
+def test_reduction_order_rejected():
+    src = (
+        "from concurrent.futures import as_completed\n"
+        "def merge(futs):\n"
+        "    total = 0.0\n"
+        "    for f in as_completed(futs):\n"
+        "        total += f.result()\n"
+        "    return total\n"
+    )
+    diags = detlint.lint_source(src, "ballista_tpu/exec/x.py")
+    assert rules_of(diags) == ["reduction-order"]
+
+
+def test_completion_order_rejected():
+    src = (
+        "from concurrent.futures import as_completed\n"
+        "def fetch(futs):\n"
+        "    out = []\n"
+        "    for f in as_completed(futs):\n"
+        "        out.append(f.result())\n"
+        "    return out\n"
+        "def stream(futs):\n"
+        "    for f in as_completed(futs):\n"
+        "        yield f.result()\n"
+    )
+    diags = detlint.lint_source(src, "ballista_tpu/executor/x.py")
+    assert rules_of(diags) == ["completion-order"] * 2
+
+
+def test_index_ordered_loop_accepts():
+    # the shipped overlapped-fetch shape: iterate locations IN ORDER,
+    # drain each location's own queue — no completion-order dependence
+    src = (
+        "def merge(queues):\n"
+        "    out = []\n"
+        "    for q in queues:\n"
+        "        while True:\n"
+        "            item = q.get()\n"
+        "            if item is None:\n"
+        "                break\n"
+        "            out.append(item)\n"
+        "    return out\n"
+    )
+    assert detlint.lint_source(src, "ballista_tpu/executor/x.py") == []
+
+
+def test_suppression_scope():
+    src = (
+        "import random\n"
+        "def pick(xs):  # detlint: disable=undeclared-rng\n"
+        "    return random.choice(xs)\n"
+    )
+    assert detlint.lint_source(src, "ballista_tpu/scheduler/x.py") == []

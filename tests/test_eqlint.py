@@ -1,0 +1,112 @@
+"""eqlint (analysis/eqlint.py): the no-uncertified-mutation closure.
+
+The tree must be clean (every structural plan mutation routes through
+ballista_tpu/rewrite.py or exec.base.replace_children), and each rule
+must reject its seeded mutation — the acceptance shape every analyzer in
+this repo follows."""
+
+from ballista_tpu.analysis import eqlint
+
+
+def rules_of(diags):
+    return [d.rule for d in diags]
+
+
+def test_tree_is_clean():
+    diags = eqlint.lint_paths()
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
+def test_direct_child_slot_write_rejected():
+    src = (
+        "def resolve(node, other):\n"
+        "    node.input = other\n"
+        "    node.left, node.right = other, other\n"
+    )
+    diags = eqlint.lint_source(src, "scheduler/server.py")
+    assert rules_of(diags) == ["uncertified-plan-write"] * 3
+    assert "rewrite" in diags[0].message
+
+
+def test_structural_scalar_write_rejected():
+    src = (
+        "def adapt(join, writer):\n"
+        "    join.join_type = 'left'\n"
+        "    join.partition_mode = 'collect'\n"
+        "    writer.output_partitions = 8\n"
+        "    writer.partition_keys = []\n"
+    )
+    diags = eqlint.lint_source(src, "exec/x.py")
+    assert rules_of(diags) == ["uncertified-plan-write"] * 4
+
+
+def test_stage_template_swap_rejected():
+    src = (
+        "def swap(job, other):\n"
+        "    st = job.stages[3]\n"
+        "    st.plan = other\n"
+        "    job.stages[4].plan = other\n"
+    )
+    diags = eqlint.lint_source(src, "scheduler/server.py")
+    assert rules_of(diags) == ["uncertified-stage-write"] * 2
+
+
+def test_constructors_are_sanctioned():
+    src = (
+        "class FooExec:\n"
+        "    def __init__(self, input, exprs):\n"
+        "        self.input = input\n"
+        "        self.exprs = list(exprs)\n"
+    )
+    assert eqlint.lint_source(src, "exec/foo.py") == []
+    # dataclass __post_init__ counts as construction too
+    src2 = (
+        "class Stage:\n"
+        "    def __post_init__(self):\n"
+        "        self.inputs = []\n"
+    )
+    assert eqlint.lint_source(src2, "scheduler/x.py") == []
+
+
+def test_self_write_outside_init_is_a_finding():
+    src = (
+        "class FooExec:\n"
+        "    def execute(self, p, ctx):\n"
+        "        self.input = None\n"
+    )
+    diags = eqlint.lint_source(src, "exec/foo.py")
+    assert rules_of(diags) == ["uncertified-plan-write"]
+
+
+def test_sanctioned_sites_pass():
+    body = "def f(p, c):\n    p.input = c\n"
+    assert eqlint.lint_source(body, "rewrite.py") == []
+    rc = "def replace_children(p, cs):\n    p.left, p.right = cs\n"
+    assert eqlint.lint_source(rc, "exec/base.py") == []
+    # the same function name in another file is NOT sanctioned
+    assert eqlint.lint_source(rc, "exec/joins.py") != []
+
+
+def test_suppression_line_and_def_scope():
+    line = (
+        "def f(n, o):\n"
+        "    n.input = o  # eqlint: disable=uncertified-plan-write\n"
+    )
+    assert eqlint.lint_source(line, "exec/x.py") == []
+    scoped = (
+        "def f(n, o):  # eqlint: disable=all\n"
+        "    n.input = o\n"
+        "    n.join_type = 1\n"
+    )
+    assert eqlint.lint_source(scoped, "exec/x.py") == []
+
+
+def test_runtime_state_fields_exempt():
+    # cost/state mutation is not semantics mutation
+    src = (
+        "def run(plan, ctx):\n"
+        "    plan.metrics = None\n"
+        "    plan._cache = (ctx, [])\n"
+        "    plan._fn = None\n"
+    )
+    assert eqlint.lint_source(src, "exec/x.py") == []

@@ -34,10 +34,8 @@ def test_control_and_data_planes_lint_clean():
     assert diags == [], "\n".join(str(d) for d in diags)
 
 
-def test_suppressions_stay_rare():
-    """Escape-hatch budget: ≤ 5 tree-wide (transfer annotations are NOT
-    suppressions and are tracked separately)."""
-    assert lifelint.suppression_count() <= 5
+# (the per-analyzer suppression-budget assertion moved to the single
+# shared ledger test: tests/test_budget.py over analysis/budget.py)
 
 
 def test_transfer_sites_are_declared_and_audited():

@@ -38,10 +38,8 @@ def test_control_plane_lints_clean():
     assert diags == [], "\n".join(str(d) for d in diags)
 
 
-def test_suppressions_stay_rare():
-    """Escape-hatch budget: ≤ 5 tree-wide (currently 1 — the documented
-    double-checked fast path in testing/faults.py active())."""
-    assert racelint.suppression_count() <= 5
+# (the per-analyzer suppression-budget assertion moved to the single
+# shared ledger test: tests/test_budget.py over analysis/budget.py)
 
 
 def test_rule_catalog_documented():

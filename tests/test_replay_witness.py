@@ -1,0 +1,189 @@
+"""Replay witness (analysis/replay.py, BALLISTA_REPLAY_WITNESS).
+
+Unit tier: canonical hashing is invariant under row order, chunking, and
+IPC compression codec while catching any value-level change; the
+record/mismatch ledger behaves like the other witnesses (zero-traffic
+cannot masquerade as success). Property tier: a real 2-executor
+distributed query records IDENTICAL hash sets under
+``shuffle_fetch_concurrency`` ∈ {1, 4}, eager vs barriered shuffle, and
+none/lz4/zstd compression — the bit-exactness invariant the chaos suites
+used to assert one table at a time, now checked key-for-key."""
+
+import pathlib
+import subprocess
+import sys
+
+import pyarrow as pa
+import pyarrow.ipc as paipc
+import pytest
+
+from ballista_tpu.analysis import replay
+from tests.conftest import CPU_MESH_ENV
+
+
+@pytest.fixture(autouse=True)
+def _clean_witness():
+    replay.reset()
+    yield
+    replay.reset()
+    replay.enable(False)
+
+
+def _table(rows=None):
+    rows = rows or [(1, 1.5, "a"), (2, 2.5, "b"), (3, 3.5, "c")]
+    k, v, s = zip(*rows)
+    return pa.table({"k": list(k), "v": list(v), "s": list(s)})
+
+
+def test_canonical_hash_order_and_chunking_invariant():
+    t = _table()
+    perm = _table([(3, 3.5, "c"), (1, 1.5, "a"), (2, 2.5, "b")])
+    assert replay.canonical_hash(t) == replay.canonical_hash(perm)
+    chunked = pa.concat_tables([t.slice(0, 1), t.slice(1)])
+    assert replay.canonical_hash(t) == replay.canonical_hash(chunked)
+
+
+def test_canonical_hash_catches_value_changes():
+    t = _table()
+    h = replay.canonical_hash(t)
+    assert h != replay.canonical_hash(_table([(1, 1.5, "a")]))  # lost rows
+    assert h != replay.canonical_hash(  # duplicated row
+        _table([(1, 1.5, "a"), (1, 1.5, "a"), (2, 2.5, "b"), (3, 3.5, "c")])
+    )
+    ulp = _table([(1, 1.5, "a"), (2, 2.5 + 1e-13, "b"), (3, 3.5, "c")])
+    assert h != replay.canonical_hash(ulp)  # last-ULP float drift
+    renamed = t.rename_columns(["k", "w", "s"])
+    assert h != replay.canonical_hash(renamed)  # schema drift
+
+
+def test_hash_file_codec_invariant(tmp_path):
+    t = _table()
+    digests = set()
+    for codec in (None, "lz4", "zstd"):
+        p = tmp_path / f"f-{codec}.arrow"
+        opts = paipc.IpcWriteOptions(compression=codec) if codec else None
+        with (
+            paipc.new_file(str(p), t.schema, options=opts)
+            if opts
+            else paipc.new_file(str(p), t.schema)
+        ) as w:
+            w.write_table(t)
+        digests.add(replay.hash_file(str(p)))
+    assert len(digests) == 1
+    # a never-created file (zero-row partition) hashes as the stable
+    # empty marker, not an error
+    assert replay.hash_file(str(tmp_path / "absent.arrow")) == "empty"
+
+
+def test_record_mismatch_and_ledger():
+    replay.enable()
+    replay.record("shuffle", ("j", 2, 0, 1), "aaa")
+    replay.record("shuffle", ("j", 2, 0, 1), "aaa")  # retry, equal
+    assert replay.mismatches() == []
+    assert replay.rehash_count() == 1
+    replay.record("shuffle", ("j", 2, 0, 1), "bbb")  # divergent recompute
+    assert len(replay.mismatches()) == 1
+    with pytest.raises(AssertionError, match="mismatch"):
+        replay.assert_clean()
+    assert "MISMATCH" in replay.summary()
+
+
+def test_zero_records_is_not_clean():
+    replay.enable()
+    with pytest.raises(AssertionError, match="recorded nothing"):
+        replay.assert_clean()
+    replay.assert_clean(require_records=False)
+
+
+def test_forget_stage_scopes_to_one_stage():
+    replay.enable()
+    replay.record("shuffle", ("j", 2, 0, 0), "aaa")
+    replay.record("shuffle", ("j", 3, 0, 0), "ccc")
+    replay.record("result", ("j", 7, 0), "rrr")
+    replay.forget_stage("j", 2)
+    replay.record("shuffle", ("j", 2, 0, 0), "bbb")  # re-bucketed: fine
+    replay.record("shuffle", ("j", 3, 0, 0), "ccc")
+    assert replay.mismatches() == []
+    snap = replay.snapshot(strip_job=True)
+    assert ("result", 7, 0) in snap
+
+
+PROPERTY_SCRIPT = r"""
+import numpy as np
+import pyarrow as pa
+
+from ballista_tpu.analysis import replay
+from ballista_tpu.client.context import BallistaContext
+from ballista_tpu.config import BallistaConfig
+
+n = 4000
+r = np.random.default_rng(7)
+fact = pa.table({
+    "k": pa.array((np.arange(n) % 97).astype(np.int64)),
+    "v": pa.array(r.uniform(0, 100, n)),
+})
+dim = pa.table({
+    "k": pa.array(np.arange(97).astype(np.int64)),
+    "name": pa.array([f"g{i%5}" for i in range(97)]),
+})
+SQL = (
+    "select name, count(*) as n, sum(v) as sv "
+    "from fact join dim on fact.k = dim.k "
+    "group by name order by name"
+)
+
+CONFIGS = [
+    {"ballista.tpu.shuffle_fetch_concurrency": "1"},
+    {"ballista.tpu.shuffle_fetch_concurrency": "4"},
+    {"ballista.tpu.eager_shuffle": "false"},
+    {"ballista.tpu.eager_shuffle": "true"},
+    {"ballista.tpu.shuffle_compression": "none"},
+    {"ballista.tpu.shuffle_compression": "zstd"},
+]
+
+replay.enable()
+snapshots = []
+for settings in CONFIGS:
+    cfg = BallistaConfig().with_setting("ballista.shuffle.partitions", "2")
+    for k, v in settings.items():
+        cfg = cfg.with_setting(k, v)
+    ctx = BallistaContext.standalone(cfg, n_executors=2)
+    ctx.register_table("fact", fact)
+    ctx.register_table("dim", dim)
+    out = ctx.sql(SQL).collect()
+    assert out.num_rows == 5, out
+    replay.assert_clean()  # within-run: no divergent re-records
+    counts = replay.record_counts()
+    assert counts.get("shuffle", 0) > 0 and counts.get("result", 0) > 0, counts
+    snapshots.append((settings, replay.snapshot(strip_job=True)))
+    replay.reset()
+    ctx.close()
+
+base_settings, base = snapshots[0]
+for settings, snap in snapshots[1:]:
+    assert set(snap) == set(base), (
+        f"{settings}: key sets differ: "
+        f"{sorted(set(snap) ^ set(base))[:6]}"
+    )
+    diff = [k for k in base if snap[k] != base[k]]
+    assert not diff, f"{settings}: hashes differ at {diff[:6]}"
+print("REPLAY-PROPERTY-OK", len(base), "keys x", len(snapshots), "configs")
+"""
+
+
+def test_hashes_invariant_across_concurrency_eager_and_codecs():
+    """The ISSUE-11 property test: same query, 6 configurations, one
+    witness key set, identical hashes everywhere."""
+    env = {k: v for k, v in CPU_MESH_ENV.items() if k != "XLA_FLAGS"}
+    proc = subprocess.run(
+        [sys.executable, "-c", PROPERTY_SCRIPT],
+        env=env,
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-4000:]}"
+    )
+    assert "REPLAY-PROPERTY-OK" in proc.stdout, proc.stdout

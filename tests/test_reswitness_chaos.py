@@ -24,13 +24,16 @@ import pathlib
 import threading
 import time
 
-from ballista_tpu.analysis import reswitness
+from ballista_tpu.analysis import replay, reswitness
 from ballista_tpu.client.context import BallistaContext
 from ballista_tpu.config import BallistaConfig
 from ballista_tpu.testing import faults
 from ballista_tpu.tpch import gen_all
 
 assert reswitness.enabled(), "BALLISTA_RESOURCE_WITNESS must reach here"
+# the replay witness rides the same chaos run: the kill + retries below
+# must re-record IDENTICAL content hashes (docs/fault_tolerance.md)
+replay.enable()
 
 faults.install(
     [{"point": "fetch_error", "partition": 0, "attempt": [0, 1],
@@ -125,6 +128,12 @@ assert counts.get("fetch-queue", 0) >= 1 or counts.get(
     "thread-pool", 0
 ) >= 1, counts
 reswitness.assert_drained()
+# replay verdict: real traffic, zero hash mismatches across the
+# kill/retry/recompute churn of every round above
+rcounts = replay.record_counts()
+assert rcounts.get("shuffle", 0) > 0, rcounts
+replay.assert_clean()
+print(f"REPLAY-CHAOS-OK {replay.summary()}")
 print(f"RESWITNESS-CHAOS-OK {sorted(counts.items())}")
 """
 
@@ -142,4 +151,5 @@ def test_zero_leaked_resources_under_kill_and_fetch_faults():
     assert proc.returncode == 0, (
         f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
     )
+    assert "REPLAY-CHAOS-OK" in proc.stdout
     assert "RESWITNESS-CHAOS-OK" in proc.stdout
