@@ -75,7 +75,7 @@ RULES: dict[str, str] = {
 _SUPPRESS_RE = re.compile(r"#\s*detlint:\s*disable=([A-Za-z0-9_,\- ]+)")
 _NONDET_RE = re.compile(r"#\s*detlint:\s*nondet=([A-Za-z0-9_\-]+)")
 
-TARGET_DIRS = ("ops", "exec", "executor", "scheduler", "compilecache")
+TARGET_DIRS = ("ops", "exec", "executor", "scheduler", "compilecache", "obs")
 # wall-clock reads are only categorically wrong in the data plane proper;
 # the control plane legitimately timestamps (heartbeats, TTLs, deadlines)
 WALLCLOCK_DIRS = ("ops", "exec", "compilecache")

@@ -70,6 +70,23 @@ BALLISTA_TRACE = "ballista.tpu.trace"  # distributed tracing: off|on|<jsonl path
 BALLISTA_METRICS_COLLECTOR = (
     "ballista.tpu.metrics_collector"  # executor metrics sink: shipping|logging
 )
+# fleet-level observability (docs/observability.md): straggler/skew
+# detection thresholds + the composite autoscale target
+BALLISTA_STRAGGLER_FACTOR = (
+    "ballista.tpu.straggler_factor"  # flag tasks > k x stage median
+)
+BALLISTA_STRAGGLER_MIN_S = (
+    "ballista.tpu.straggler_min_s"  # noise floor for straggler flags
+)
+BALLISTA_SKEW_RATIO = (
+    "ballista.tpu.skew_ratio"  # flag partitions > k x stage median rows
+)
+BALLISTA_SKEW_MIN_ROWS = (
+    "ballista.tpu.skew_min_rows"  # noise floor for skew flags
+)
+BALLISTA_SCALER_QUEUE_WAIT_TARGET_S = (
+    "ballista.tpu.scaler_queue_wait_target_s"  # KEDA pressure target
+)
 
 METRICS_COLLECTORS = ("shipping", "logging")
 
@@ -130,6 +147,10 @@ BALLISTA_INTERNAL_TASK_ATTEMPT = "ballista.internal.task_attempt"
 # submission + the parent span id (the stage's span) for the task attempt
 BALLISTA_INTERNAL_TRACE_ID = "ballista.internal.trace_id"
 BALLISTA_INTERNAL_SPAN_PARENT = "ballista.internal.span_parent"
+# fleet observability (docs/observability.md): the job's query-class
+# token rides every task so the executor's task-run histogram aggregates
+# by the same label the scheduler's job-latency series uses
+BALLISTA_INTERNAL_QUERY_CLASS = "ballista.internal.query_class"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -625,6 +646,55 @@ def _entries() -> dict[str, ConfigEntry]:
             _parse_metrics_collector,
         ),
         ConfigEntry(
+            BALLISTA_STRAGGLER_FACTOR,
+            "Straggler monitor (docs/observability.md): a completed task "
+            "whose duration exceeds this factor times the median of its "
+            "stage's completed task durations (with at least 3 "
+            "completions to form a median) is flagged — a `straggler` "
+            "trace event, the ballista_stragglers_total counter, and the "
+            "/api/job/<id>/timeline straggler bit. <= 0 disables.",
+            "3",
+            float,
+        ),
+        ConfigEntry(
+            BALLISTA_STRAGGLER_MIN_S,
+            "Noise floor for the straggler monitor: tasks faster than "
+            "this are never flagged regardless of the ratio (sub-second "
+            "scheduling jitter would otherwise flag trivial stages).",
+            "1",
+            float,
+        ),
+        ConfigEntry(
+            BALLISTA_SKEW_RATIO,
+            "Skew monitor (docs/observability.md): when a stage "
+            "completes, a (stage, partition) whose processed rows exceed "
+            "this ratio over the stage's median partition is flagged — a "
+            "`skew` trace event, the ballista_skew_partitions_total "
+            "counter, and /api/job/<id> skew list. This is the signal "
+            "the AQE split/coalesce policy consumes. <= 0 disables.",
+            "4",
+            float,
+        ),
+        ConfigEntry(
+            BALLISTA_SKEW_MIN_ROWS,
+            "Noise floor for the skew monitor: partitions smaller than "
+            "this many rows are never flagged (splitting tiny partitions "
+            "cannot help anyone).",
+            "4096",
+            int,
+        ),
+        ConfigEntry(
+            BALLISTA_SCALER_QUEUE_WAIT_TARGET_S,
+            "Declared queue-wait target for the KEDA ExternalScaler's "
+            "composite pressure signal (docs/observability.md): when the "
+            "p90 of recent job queue waits (submit -> first task "
+            "assignment) exceeds this, the reported desired-executor "
+            "count scales up proportionally (capped at 4x) on top of the "
+            "inflight-task demand. <= 0 disables the queue-wait term.",
+            "2",
+            float,
+        ),
+        ConfigEntry(
             BALLISTA_EAGER_WAIT_S,
             "Deadline (seconds) an eager reader waits for a "
             "not-yet-published upstream location before failing the task "
@@ -790,6 +860,21 @@ class BallistaConfig:
 
     def metrics_collector(self) -> str:
         return self._get(BALLISTA_METRICS_COLLECTOR)
+
+    def straggler_factor(self) -> float:
+        return self._get(BALLISTA_STRAGGLER_FACTOR)
+
+    def straggler_min_s(self) -> float:
+        return max(0.0, self._get(BALLISTA_STRAGGLER_MIN_S))
+
+    def skew_ratio(self) -> float:
+        return self._get(BALLISTA_SKEW_RATIO)
+
+    def skew_min_rows(self) -> int:
+        return max(0, self._get(BALLISTA_SKEW_MIN_ROWS))
+
+    def scaler_queue_wait_target_s(self) -> float:
+        return self._get(BALLISTA_SCALER_QUEUE_WAIT_TARGET_S)
 
     def __eq__(self, other) -> bool:
         return (

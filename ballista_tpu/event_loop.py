@@ -44,10 +44,28 @@ class EventAction:
         log.error("event loop error: %s", error, exc_info=error)
 
 
+class _Timed:
+    """Post-time envelope for dispatch-lag measurement (only when a
+    ``lag_cb`` is installed). A dedicated class, not a tuple: tests and
+    embedders inject raw events straight into the queue, and raw tuples
+    must keep flowing through untouched."""
+
+    __slots__ = ("posted", "event")
+
+    def __init__(self, posted: float, event) -> None:
+        self.posted = posted
+        self.event = event
+
+
 class EventLoop:
     def __init__(self, name: str, action: EventAction):
         self.name = name
         self.action = action
+        # observability hook (docs/observability.md): when set, every
+        # consumed event reports (now - post time) seconds — the
+        # scheduler feeds this into the ballista_event_dispatch_lag_seconds
+        # histogram, the direct measure of control-plane saturation
+        self.lag_cb = None
         self._q: queue.Queue = queue.Queue(maxsize=_BUFFER)
         # consumer-thread posts that found the queue full; only the
         # consumer thread itself appends/pops, so no lock is needed
@@ -91,6 +109,10 @@ class EventLoop:
         guaranteed self-deadlock: nothing else drains the queue), so its
         posts spill to the unbounded overflow deque instead; terminal
         events like JobFailed are never dropped."""
+        if self.lag_cb is not None:
+            import time
+
+            event = _Timed(time.monotonic(), event)
         if threading.current_thread() is self._thread:
             try:
                 self._q.put_nowait(event)
@@ -137,6 +159,17 @@ class EventLoop:
             try:
                 if event is None:
                     continue
+                if isinstance(event, _Timed):
+                    cb = self.lag_cb
+                    if cb is not None:
+                        import time
+
+                        try:
+                            cb(time.monotonic() - event.posted)
+                        except Exception:  # noqa: BLE001 — metering must
+                            # never take the consumer down
+                            log.exception("event-loop lag callback failed")
+                    event = event.event
                 try:
                     follow_up = self.action.on_receive(event)
                 except Exception as e:  # noqa: BLE001

@@ -184,6 +184,7 @@ class Executor:
         the per-operator metrics the session's collector chose to ship."""
         from ballista_tpu.config import (
             BALLISTA_INTERNAL_PREFIX,
+            BALLISTA_INTERNAL_QUERY_CLASS,
             BALLISTA_INTERNAL_SPAN_PARENT,
             BALLISTA_INTERNAL_TASK_ATTEMPT,
             BALLISTA_INTERNAL_TRACE_ID,
@@ -194,6 +195,12 @@ class Executor:
         # session config: strip them before BallistaConfig validation
         # rejects the unknown prefix
         attempt = int(props_early.get(BALLISTA_INTERNAL_TASK_ATTEMPT, "0"))
+        # fleet observability: the job's query class labels this
+        # executor's task-run histogram with the same token the
+        # scheduler's job-latency series uses (docs/observability.md)
+        query_class = props_early.get(
+            BALLISTA_INTERNAL_QUERY_CLASS, "unknown"
+        )
         # distributed tracing (docs/observability.md): the scheduler stamps
         # these only when the session traces, so "no prop" IS the
         # zero-overhead off path
@@ -305,6 +312,7 @@ class Executor:
                 task.task_id.partition_id, ctx
             )
 
+        run_t0 = time.perf_counter()
         with span_cm:
             out = run_with_capacity_retry(
                 config,
@@ -322,6 +330,16 @@ class Executor:
                     self.shuffle_locations if self.scheduler_addr else None
                 ),
             )
+        # task-run duration into the process-local fleet histogram
+        # (obs/hist.REGISTRY): served by --metrics-port, and shipped home
+        # as deltas on the next poll/heartbeat (docs/observability.md)
+        from ballista_tpu.obs import hist as obs_hist
+
+        obs_hist.REGISTRY.histogram(
+            "ballista_executor_task_run_seconds",
+            "Successful task-attempt run duration by query class",
+            ("class",),
+        ).labels(query_class).observe(time.perf_counter() - run_t0)
         self._plan_cache.update(attempt_cache)
         self._hints.save_if_changed(self._capacity_hint, self._plan_cache)
         from ballista_tpu.analysis import replay
@@ -511,9 +529,11 @@ class PollLoop:
             if can_accept:
                 self._available.release()
             from ballista_tpu.compilecache import metrics as compile_metrics
+            from ballista_tpu.obs import hist as obs_hist
             from ballista_tpu.obs import trace as obs_trace
 
             spans = obs_trace.drain_outbox()
+            hist_deltas = obs_hist.REGISTRY.drain_deltas()
             try:
                 result = stub.PollWork(
                     pb.PollWorkParams(
@@ -526,20 +546,24 @@ class PollLoop:
                             pb.KeyValuePair(key=k, value=str(v))
                             for k, v in compile_metrics.snapshot().items()
                         ],
-                        # drained trace spans ride the same liveness RPC
+                        # drained trace spans + latency-histogram deltas
+                        # ride the same liveness RPC
                         # (docs/observability.md)
                         spans=[obs_trace.span_to_proto(s) for s in spans],
+                        hists=obs_hist.deltas_to_proto(hist_deltas),
                     )
                 )
             except grpc.RpcError as e:
                 log.warning("poll_work failed: %s", e)
-                # re-enqueue the drained statuses (and spans) for the next
-                # successful poll — dropping them left tasks RUNNING
-                # forever on the scheduler (statuses are reported exactly
-                # once; spans are shipped exactly once too)
+                # re-enqueue the drained statuses (and spans, and
+                # histogram deltas) for the next successful poll —
+                # dropping them left tasks RUNNING forever on the
+                # scheduler (statuses are reported exactly once; spans
+                # and histogram deltas ship exactly once too)
                 for st in statuses:
                     self._statuses.put(st)
                 obs_trace.requeue_outbox(spans)
+                obs_hist.REGISTRY.requeue_deltas(hist_deltas)
                 time.sleep(1.0)
                 continue
             if result.HasField("task"):

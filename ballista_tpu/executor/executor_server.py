@@ -179,9 +179,11 @@ class ExecutorServer:
                 # this executor go silent
                 continue
             from ballista_tpu.compilecache import metrics as compile_metrics
+            from ballista_tpu.obs import hist as obs_hist
             from ballista_tpu.obs import trace as obs_trace
 
             spans = obs_trace.drain_outbox()
+            hist_deltas = obs_hist.REGISTRY.drain_deltas()
             try:
                 result = self._sched.HeartBeatFromExecutor(
                     pb.HeartBeatParams(
@@ -196,6 +198,10 @@ class ExecutorServer:
                         # trace spans not already shipped with a task
                         # status (flight serve spans, stragglers)
                         spans=[obs_trace.span_to_proto(s) for s in spans],
+                        # latency-histogram deltas (task-run, shuffle-
+                        # fetch-wait) merge into the scheduler's fleet
+                        # registry (docs/observability.md)
+                        hists=obs_hist.deltas_to_proto(hist_deltas),
                     ),
                     timeout=RPC_TIMEOUT_S,
                 )
@@ -222,9 +228,10 @@ class ExecutorServer:
                     )
             except grpc.RpcError as e:
                 log.warning("heartbeat failed: %s", e)
-                # spans ship exactly once: a failed beat re-queues what it
-                # drained for the next one
+                # spans + histogram deltas ship exactly once: a failed
+                # beat re-queues what it drained for the next one
                 obs_trace.requeue_outbox(spans)
+                obs_hist.REGISTRY.requeue_deltas(hist_deltas)
 
     def _runner_loop(self) -> None:
         """ref run_task :176-254 — decode, execute, push status back."""

@@ -534,6 +534,17 @@ def _iter_location_batches(
                 return
             start_fetch(loc)
 
+    # resolved ONCE per read, not per stalled batch: the stall branch is
+    # per-batch under fetch pressure, and re-resolving the vec would add
+    # registry-lock acquisitions to the data-plane hot loop
+    from ballista_tpu.obs import hist as obs_hist
+
+    fetch_wait_hist = obs_hist.REGISTRY.histogram(
+        "ballista_shuffle_fetch_wait_seconds",
+        "Consumer stall time waiting on shuffle fetches "
+        "(the overlap window could still hide this)",
+    ).labels()
+
     try:
         top_up()
         while True:
@@ -551,8 +562,16 @@ def _iter_location_batches(
                     buffered = True
                 except _queue.Empty:
                     buffered = False
+                    # genuine network wait: the consumer stalled on the
+                    # fetch pipeline. The duration feeds the fleet
+                    # shuffle-fetch-wait histogram (obs/hist.py) shipped
+                    # home on poll/heartbeat (docs/observability.md).
+                    wait_t0 = _time.perf_counter()
                     with metrics.time("fetch_time"):
                         item = q.get()
+                    fetch_wait_hist.observe(
+                        _time.perf_counter() - wait_t0
+                    )
                 if item is _DONE:
                     break
                 if isinstance(item, _Err):

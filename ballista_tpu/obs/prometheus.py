@@ -38,26 +38,70 @@ def _esc(v: str) -> str:
 
 
 def render(families: list[tuple]) -> str:
-    """``families``: [(name, type, help, [(labels-dict, value), ...])].
-    Renders valid exposition text: one ``# HELP``/``# TYPE`` header per
-    family, samples sorted by label for output stability."""
+    """``families``: [(name, type, help, samples)]. A sample is either
+    ``(labels-dict, value)`` (gauge/counter — sorted by label for output
+    stability) or ``(suffix, labels-dict, value)`` (histogram
+    ``_bucket``/``_sum``/``_count`` samples — emitted in the given order
+    so cumulative ``le`` buckets stay ascending). Renders valid
+    exposition text with one ``# HELP``/``# TYPE`` header per family."""
     out: list[str] = []
     for name, mtype, help_text, samples in families:
         name = sanitize_name(name)
         out.append(f"# HELP {name} {help_text}")
         out.append(f"# TYPE {name} {mtype}")
+        plain = [s for s in samples if len(s) == 2]
+        suffixed = [s for s in samples if len(s) == 3]
         for labels, value in sorted(
-            samples, key=lambda s: sorted(s[0].items())
+            plain, key=lambda s: sorted(s[0].items())
         ):
-            if labels:
-                body = ",".join(
-                    f'{_LABEL_OK.sub("_", k)}="{_esc(v)}"'
-                    for k, v in sorted(labels.items())
-                )
-                out.append(f"{name}{{{body}}} {_fmt(value)}")
-            else:
-                out.append(f"{name} {_fmt(value)}")
+            out.append(f"{name}{_labels(labels)} {_fmt(value)}")
+        for suffix, labels, value in suffixed:
+            out.append(
+                f"{name}{sanitize_name(suffix)}{_labels(labels)} "
+                f"{_fmt(value)}"
+            )
     return "\n".join(out) + "\n"
+
+
+_EXP_HELP_RE = re.compile(r"^# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
+_EXP_TYPE_RE = re.compile(
+    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (gauge|counter|histogram)$"
+)
+_EXP_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\})?"
+    r" -?[0-9.e+-]+$"
+)
+
+
+def validate_exposition(text: str) -> None:
+    """Assert ``text`` is well-formed exposition (every line a valid
+    HELP/TYPE header or sample). Production-side consumers (the SLO
+    harness scraping its own /api/metrics) share THIS validator; the
+    tier-1 parser test keeps an independent copy on purpose — validating
+    the renderer with the renderer's own module would be circular."""
+    if not text.endswith("\n"):
+        raise AssertionError("exposition must end with a newline")
+    for line in text.splitlines():
+        if line.startswith("# HELP"):
+            ok = _EXP_HELP_RE.match(line)
+        elif line.startswith("# TYPE"):
+            ok = _EXP_TYPE_RE.match(line)
+        else:
+            ok = _EXP_SAMPLE_RE.match(line)
+        if not ok:
+            raise AssertionError(f"invalid exposition line: {line!r}")
+
+
+def _labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    body = ",".join(
+        f'{_LABEL_OK.sub("_", k)}="{_esc(v)}"'
+        for k, v in sorted(labels.items())
+    )
+    return "{" + body + "}"
 
 
 def _fmt(v) -> str:
@@ -132,8 +176,63 @@ def scheduler_families(server) -> list[tuple]:
              [({"counter": sanitize_name(k)}, v)
               for k, v in sorted(task_counters.items())])
         )
+    # fleet-level distributional plane (docs/observability.md): straggler/
+    # skew detection counters, the composite autoscale signal, span-drop
+    # accounting, and every latency histogram (scheduler-observed + deltas
+    # shipped home by executors)
+    with server._lock:
+        stragglers = dict(server.obs_straggler_total)
+        skews = dict(server.obs_skew_total)
+    families.append(
+        ("ballista_stragglers_total", "counter",
+         "Tasks flagged by the per-stage straggler monitor "
+         "(duration > straggler_factor x stage median)",
+         [({"class": c}, n) for c, n in sorted(stragglers.items())]
+         or [({}, 0)])
+    )
+    families.append(
+        ("ballista_skew_partitions_total", "counter",
+         "Partitions flagged by the skew monitor "
+         "(rows > skew_ratio x stage median — the AQE split signal)",
+         [({"class": c}, n) for c, n in sorted(skews.items())]
+         or [({}, 0)])
+    )
+    with server._lock:
+        overflow = server.obs_class_overflow
+        n_classes = len(server._known_classes)
+    families.append(
+        ("ballista_query_classes", "gauge",
+         "Distinct query-class labels in use (capped at "
+         "max_query_classes; the tail aggregates under 'overflow')",
+         [({}, n_classes)])
+    )
+    families.append(
+        ("ballista_query_class_overflow_total", "counter",
+         "Jobs classed 'overflow' because the query-class cardinality "
+         "cap was reached (no-silent-caps accounting)",
+         [({}, overflow)])
+    )
+    families.append(
+        ("ballista_desired_executors", "gauge",
+         "Composite autoscale pressure: executors the KEDA ExternalScaler "
+         "currently asks for (pending tasks + queue-wait p90 vs target)",
+         [({}, server.desired_executors())])
+    )
+    families.extend(_span_drop_families())
+    families.extend(server.hists.families())
     families.extend(_reswitness_families())
     return families
+
+
+def _span_drop_families() -> list[tuple]:
+    from ballista_tpu.obs import trace
+
+    return [
+        ("ballista_spans_dropped_total", "counter",
+         "Spans evicted from the bounded trace stores (ring window, "
+         "executor shipping outbox) — the no-silent-caps accounting",
+         [({"buffer": k}, v) for k, v in sorted(trace.dropped().items())])
+    ]
 
 
 def executor_families() -> list[tuple]:
@@ -141,6 +240,8 @@ def executor_families() -> list[tuple]:
     in-process trace ring size + live resources)."""
     from ballista_tpu.compilecache import metrics as compile_metrics
     from ballista_tpu.obs import trace
+
+    from ballista_tpu.obs import hist as obs_hist
 
     families = [
         ("ballista_executor_compile", "gauge",
@@ -151,6 +252,10 @@ def executor_families() -> list[tuple]:
          "Spans currently buffered in the in-process trace ring",
          [({}, trace.ring_size())]),
     ]
+    families.extend(_span_drop_families())
+    # process-local latency histograms (task-run, shuffle-fetch-wait);
+    # the same observations also ship home as deltas on poll/heartbeat
+    families.extend(obs_hist.REGISTRY.families())
     families.extend(_reswitness_families())
     return families
 

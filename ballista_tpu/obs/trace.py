@@ -47,6 +47,15 @@ _RING: collections.deque = collections.deque(maxlen=_RING_CAP)
 _OUTBOX: collections.deque = collections.deque(maxlen=_OUTBOX_CAP)
 _MODE: str = "off"  # JSONL export: "off" | "on" | <path>
 _SHIP: bool = False  # executor processes stage spans for RPC shipping
+# No-silent-caps (docs/analysis.md): both bounded stores count what they
+# evict, surfaced as ballista_spans_dropped_total{buffer=...}. The two
+# buffers mean different things: buffer="outbox" is REAL loss (a span
+# evicted before it shipped) and must stay 0 on a healthy deployment;
+# buffer="ring" is the debugging window rotating — expected once a
+# traced process records more than _RING_CAP spans, alert-worthy only
+# if you expected the window to hold everything. The SLO harness runs
+# untraced, so it asserts the combined total is 0.
+_DROPPED: dict[str, int] = {"ring": 0, "outbox": 0}
 
 _TLS = threading.local()
 
@@ -106,8 +115,12 @@ def enable_shipping(flag: bool = True) -> None:
 
 def record(span: Span) -> None:
     with _LOCK:
+        if len(_RING) == _RING_CAP:
+            _DROPPED["ring"] += 1
         _RING.append(span)
         if _SHIP:
+            if len(_OUTBOX) == _OUTBOX_CAP:
+                _DROPPED["outbox"] += 1
             _OUTBOX.append(span)
         mode = _MODE
     if mode not in ("off", "on"):
@@ -138,11 +151,20 @@ def ring_size() -> int:
         return len(_RING)
 
 
+def dropped() -> dict[str, int]:
+    """Spans evicted from the bounded stores, by buffer (the
+    ``ballista_spans_dropped_total`` series)."""
+    with _LOCK:
+        return dict(_DROPPED)
+
+
 def clear() -> None:
-    """Drop ring + outbox (test isolation)."""
+    """Drop ring + outbox + drop counters (test isolation)."""
     with _LOCK:
         _RING.clear()
         _OUTBOX.clear()
+        _DROPPED["ring"] = 0
+        _DROPPED["outbox"] = 0
 
 
 def drain_outbox() -> list[Span]:
@@ -157,7 +179,12 @@ def drain_outbox() -> list[Span]:
 
 def requeue_outbox(spans: list[Span]) -> None:
     with _LOCK:
-        # re-queue at the FRONT so ordering survives a poll failure
+        # re-queue at the FRONT so ordering survives a poll failure; a
+        # full outbox evicts from the BACK (the newest staged spans) —
+        # counted, like every bounded-store eviction here
+        overflow = len(_OUTBOX) + len(spans) - _OUTBOX_CAP
+        if overflow > 0:
+            _DROPPED["outbox"] += overflow
         _OUTBOX.extendleft(reversed(spans))
 
 

@@ -20,6 +20,17 @@ EXTERNAL_SCALER_METHODS = {
     "GetMetrics": (pb.GetMetricsRequest, pb.GetMetricsResponse),
 }
 
+# PR 12 (docs/observability.md): the scale signal is no longer the raw
+# inflight count — GetMetrics reports SchedulerServer.desired_executors(),
+# the composite pressure (inflight tasks over per-executor slots, scaled
+# up when queue-wait p90 exceeds the declared target) also exposed as the
+# ballista_desired_executors gauge. With targetSize=1 KEDA's replica math
+# (metricValue / targetSize) then IS the desired executor count.
+COMPOSITE_PRESSURE_METRIC_NAME = "desired_executors"
+# Pre-PR-12 metric name: a GetMetrics request that explicitly asks for
+# it (a ScaledObject pinning `metricName: inflight_tasks`) still gets
+# the raw inflight count under that name — real back-compat, not an
+# advertised default (GetMetricSpec only announces the composite).
 INFLIGHT_TASKS_METRIC_NAME = "inflight_tasks"
 
 
@@ -38,24 +49,38 @@ class ExternalScalerServicer:
         )
 
     def GetMetricSpec(self, request: pb.ScaledObjectRef, context):
-        # ref :43-53 — one metric, target 1 task per replica
+        # ref :43-53 — one metric; target 1 means metricValue is read
+        # directly as the replica count
         return pb.GetMetricSpecResponse(
             metricSpecs=[
                 pb.MetricSpec(
-                    metricName=INFLIGHT_TASKS_METRIC_NAME, targetSize=1
+                    metricName=COMPOSITE_PRESSURE_METRIC_NAME, targetSize=1
                 )
             ]
         )
 
     def GetMetrics(self, request: pb.GetMetricsRequest, context):
-        # ref :55-66 reports a huge constant to saturate the HPA while work
-        # exists; reporting the actual inflight count gives KEDA a real
-        # signal and the same saturating behavior for large jobs
+        # ref :55-66 reports a huge constant to saturate the HPA while
+        # work exists; the composite pressure signal gives KEDA the
+        # actual executor count the queue state asks for — including the
+        # queue-wait term that raw inflight counting cannot see (jobs
+        # stacking up behind few big tasks)
+        if request.metricName == INFLIGHT_TASKS_METRIC_NAME:
+            # back-compat: a ScaledObject still pinning the pre-PR-12
+            # name keeps its raw-inflight / 1-task-per-replica semantics
+            return pb.GetMetricsResponse(
+                metricValues=[
+                    pb.MetricValue(
+                        metricName=INFLIGHT_TASKS_METRIC_NAME,
+                        metricValue=self.s.stage_manager.inflight_tasks(),
+                    )
+                ]
+            )
         return pb.GetMetricsResponse(
             metricValues=[
                 pb.MetricValue(
-                    metricName=INFLIGHT_TASKS_METRIC_NAME,
-                    metricValue=self.s.stage_manager.inflight_tasks(),
+                    metricName=COMPOSITE_PRESSURE_METRIC_NAME,
+                    metricValue=self.s.desired_executors(),
                 )
             ]
         )

@@ -296,6 +296,14 @@ def job_detail(server, job_id: str) -> dict | None:
             "rewrites": job.total_rewrites,
             "rewrite_rejects": job.total_rewrite_rejects,
             "trace_id": job.trace_id,
+            # fleet observability (docs/observability.md): the label
+            # every latency series for this job aggregates under, plus
+            # the skew monitor's flagged partitions (the AQE split input)
+            "query_class": job.query_class,
+            "skew": [
+                {"stage_id": s, "partition": p}
+                for s, p in sorted(job.skew_flags)
+            ],
         }
     # stats/trace aggregation takes the server lock itself — outside the
     # block above (the lock is reentrant, but the narrower the section
@@ -310,6 +318,78 @@ def job_detail(server, job_id: str) -> dict | None:
     if trace:
         out["spans"] = trace
     return out
+
+
+def job_timeline(server, job_id: str) -> dict | None:
+    """``GET /api/job/<id>/timeline``: the per-task Gantt view
+    (docs/observability.md) — one row per (stage, partition) with the
+    current attempt's wall-clock window, executor, attempt count, and
+    the straggler/skew flags. Reconstructed from the stage bookkeeping
+    while the job runs and from the completion snapshot afterwards;
+    running tasks additionally get a LIVE straggler projection (now -
+    start already beyond the flag threshold) so a wedged task shows up
+    before it finishes. None for unknown jobs."""
+    with server._lock:
+        job = server.jobs.get(job_id)
+        if job is None:
+            return None
+        skew = set(job.skew_flags)
+    stages = job.stage_stats
+    if stages is None:
+        stages = server.stage_manager.job_stage_detail(job_id)
+    from ballista_tpu.scheduler.stage_manager import straggler_stats
+
+    cfg = server._session_config(job.session_id)
+    factor = cfg.straggler_factor()
+    min_s = cfg.straggler_min_s()
+    now = time.time()
+    tasks = []
+    for st in stages:
+        durations = [
+            t["ended_s"] - t["started_s"]
+            for t in st["tasks"]
+            if t["state"] == "completed" and t["started_s"] and t["ended_s"]
+        ]
+        # the SAME threshold the committing monitor uses — the live
+        # projection must agree with the counter about the same task
+        stats = straggler_stats(durations, factor, min_s)
+        threshold = stats[0] if stats is not None else None
+        for t in st["tasks"]:
+            start, end = t["started_s"], t["ended_s"]
+            dur = (end - start) if (start and end) else (
+                (now - start) if start else 0.0
+            )
+            straggler = bool(t.get("straggler"))
+            if (
+                not straggler
+                and threshold is not None
+                and t["state"] == "running"
+                and start
+                and now - start > threshold
+            ):
+                straggler = True  # live projection, not yet committed
+            tasks.append(
+                {
+                    "stage_id": st["stage_id"],
+                    "partition": t["partition"],
+                    "state": t["state"],
+                    "executor_id": t["executor_id"],
+                    "attempts": t["attempts"],
+                    "start_s": start,
+                    "end_s": end,
+                    "duration_s": round(max(0.0, dur), 6),
+                    "straggler": straggler,
+                    "skewed": (st["stage_id"], t["partition"]) in skew,
+                }
+            )
+    return {
+        "job_id": job_id,
+        "status": job.status,
+        "query_class": job.query_class,
+        "submitted_s": round(job.submitted_s, 6),
+        "first_assign_s": round(job.first_assign_s, 6),
+        "tasks": tasks,
+    }
 
 
 def start_rest_server(server, host: str = "0.0.0.0", port: int = 0):
@@ -353,8 +433,14 @@ def start_rest_server(server, host: str = "0.0.0.0", port: int = 0):
             elif path.startswith("/api/job/"):
                 from urllib.parse import unquote
 
-                job_id = unquote(path[len("/api/job/"):])
-                detail = job_detail(server, job_id)
+                tail = unquote(path[len("/api/job/"):])
+                if tail.endswith("/timeline"):
+                    # per-task Gantt view (docs/observability.md)
+                    job_id = tail[: -len("/timeline")]
+                    detail = job_timeline(server, job_id)
+                else:
+                    job_id = tail
+                    detail = job_detail(server, job_id)
                 if detail is None:
                     # REST hardening: a proper 404 with a JSON body (the
                     # stdlib send_error serves an HTML error page, which

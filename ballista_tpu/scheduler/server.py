@@ -162,6 +162,14 @@ class JobInfo:
     stage_stats: list | None = None
     # the OPEN root span (finished at job completion/failure)
     root_span: object = None
+    # fleet observability (docs/observability.md): the query-class label
+    # (obs.qclass.plan_class — repeated query shapes share one series),
+    # submission + first-task-assignment timestamps (queue wait = the
+    # gap), and the skew monitor's flagged (stage, partition) pairs
+    query_class: str = "unknown"
+    submitted_s: float = 0.0
+    first_assign_s: float = 0.0
+    skew_flags: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -274,6 +282,58 @@ class SchedulerServer:
         self.obs_task_counters: dict[str, float] = {}
         self._obs_retained: collections.deque = collections.deque()
         self.obs_retained_jobs = 50
+        # fleet-level distributional plane (docs/observability.md): an
+        # INSTANCE registry (never the executor-process module registry —
+        # an in-proc standalone cluster would double-count shipped
+        # deltas) holding the scheduler's own latency observations plus
+        # everything executors ship home on poll/heartbeat
+        from ballista_tpu.obs import hist as obs_hist
+
+        self.hists = obs_hist.Registry("scheduler")
+        self._h_job_latency = self.hists.histogram(
+            "ballista_job_latency_seconds",
+            "End-to-end job latency (submit -> completed) by query class",
+            ("class",),
+        )
+        self._h_queue_wait = self.hists.histogram(
+            "ballista_queue_wait_seconds",
+            "Queue wait (submit -> first task assignment) by query class",
+            ("class",),
+        )
+        self._h_stage_task = self.hists.histogram(
+            "ballista_stage_task_seconds",
+            "Per-task durations by query class and stage",
+            ("class", "stage"),
+        )
+        self._h_dispatch_lag = self.hists.histogram(
+            "ballista_event_dispatch_lag_seconds",
+            "Scheduler event-loop dispatch lag (post -> handler entry)",
+            (),
+        )
+        # straggler/skew counters by query class + the recent queue-wait
+        # window the composite autoscale signal reads (p90 of the last N
+        # waits — a cumulative histogram cannot answer "right now").
+        # Entries are (recorded_at, wait_s): the p90 is computed over a
+        # RECENCY window, not just the last N samples — with no arrivals
+        # nothing new is appended, and a count-only window would keep a
+        # burst's waits applying the 4x scale-up long after the queue
+        # drained.
+        self.obs_straggler_total: dict[str, int] = {}
+        self.obs_skew_total: dict[str, int] = {}
+        self._recent_queue_waits: collections.deque = collections.deque(
+            maxlen=64
+        )
+        self.queue_wait_window_s = 120.0
+        # bounded label cardinality (no-silent-caps): the class
+        # fingerprint keeps literal differences distinct, so a
+        # parameterized workload (WHERE id = <user>) could mint one
+        # class per literal — every class creates never-evicted
+        # histogram children here AND on every executor. Beyond the cap,
+        # new shapes aggregate under "overflow" and the overflow is
+        # COUNTED (ballista_query_class_overflow_total).
+        self._known_classes: set[str] = set()
+        self.max_query_classes = 256
+        self.obs_class_overflow = 0
         self.state = None
         if state_backend is not None:
             from ballista_tpu.scheduler.persistent_state import (
@@ -285,6 +345,9 @@ class SchedulerServer:
             )
             self._recover_state()
         self.event_loop = EventLoop("query-stage", QueryStageScheduler(self))
+        # dispatch-lag metering: installed BEFORE start so every event is
+        # enveloped; the observe is lock-cheap and allocation-free
+        self.event_loop.lag_cb = self._h_dispatch_lag.labels().observe
         self.event_loop.start()
         import time as _time
 
@@ -572,8 +635,27 @@ class SchedulerServer:
         if trace is None:
             # direct physical submissions (tests, embedders) trace too
             trace = self._mint_trace(self._session_config(session_id))
+        # query-class fingerprint BEFORE stage splitting (no job ids or
+        # locations exist yet to leak into it) — the label every fleet
+        # latency series aggregates by (docs/observability.md)
+        from ballista_tpu.obs.qclass import plan_class
+
+        qclass = plan_class(physical)
+        import time as _time
+
+        now = _time.time()
         with self._lock:
+            if qclass not in self._known_classes:
+                if len(self._known_classes) < self.max_query_classes:
+                    self._known_classes.add(qclass)
+                else:
+                    # cardinality cap: aggregate the long tail instead of
+                    # leaking one histogram-child set per distinct shape
+                    self.obs_class_overflow += 1
+                    qclass = "overflow"
             job = JobInfo(job_id=job_id, session_id=session_id)
+            job.query_class = qclass
+            job.submitted_s = now
             if trace is not None:
                 job.trace_id = trace["trace_id"]
                 root = trace["root"]
@@ -692,6 +774,184 @@ class SchedulerServer:
                         self.obs_task_counters[k] = (
                             self.obs_task_counters.get(k, 0) + v
                         )
+
+    def ingest_hists(self, hist_protos) -> None:
+        """Executor-shipped latency-histogram deltas (poll/heartbeat
+        RPCs) merge into the scheduler's registry — the fleet view
+        /api/metrics serves (docs/observability.md). Exception-guarded:
+        this runs on the liveness RPC BEFORE apply_task_statuses, and a
+        malformed delta (a version-skewed executor shipping a family
+        with different labels) escaping here would poison-pill EVERY
+        retry of that executor's poll — its statuses would never apply
+        and its RUNNING tasks would strand. Metering must never outrank
+        the work it rides along with."""
+        if not hist_protos:
+            return
+        from ballista_tpu.obs import hist as obs_hist
+
+        try:
+            self.hists.ingest(obs_hist.deltas_from_proto(hist_protos))
+        except Exception:  # noqa: BLE001
+            log.exception("dropping unmergeable histogram deltas")
+
+    def _observe_task_completion(self, tid: PartitionId) -> None:
+        """Per-task duration into the stage histogram + the straggler
+        check (docs/observability.md): a completed task exceeding
+        straggler_factor x the median of its stage's completed durations
+        (noise-floored) is flagged once — trace event, counter, timeline
+        bit."""
+        sm = self.stage_manager
+        # consume-once: a replayed COMPLETED status (lost RPC response,
+        # executor resend) must not observe the same attempt window into
+        # the histogram twice
+        dur = sm.take_unmetered_runtime(
+            tid.job_id, tid.stage_id, tid.partition_id
+        )
+        if dur is None:
+            return
+        job = self._get_job(tid.job_id)
+        if job is None:
+            return
+        self._h_stage_task.labels(
+            job.query_class, str(tid.stage_id)
+        ).observe(dur)
+        cfg = self._session_config(job.session_id)
+        # noise-floor fast path: the threshold is always >= min_s, so a
+        # sub-floor task can never flag — skip the per-completion
+        # durations scan+sort entirely (on a wide stage that scan is
+        # O(n) per completion on the poll-RPC status path)
+        if dur <= cfg.straggler_min_s():
+            return
+        durations = sm.completed_durations(tid.job_id, tid.stage_id)
+        from ballista_tpu.scheduler.stage_manager import straggler_stats
+
+        # (fewer than 3 completions -> no threshold: a 2-task stage
+        # cannot name a straggler without one of them being half the
+        # evidence)
+        stats = straggler_stats(
+            durations, cfg.straggler_factor(), cfg.straggler_min_s()
+        )
+        if stats is None:
+            return
+        threshold, med = stats
+        if dur <= threshold:
+            return
+        if not sm.mark_straggler(tid.job_id, tid.stage_id,
+                                 tid.partition_id):
+            return
+        with self._lock:
+            self.obs_straggler_total[job.query_class] = (
+                self.obs_straggler_total.get(job.query_class, 0) + 1
+            )
+        self._job_event(
+            job, "straggler",
+            parent_id=self._stage_span_id(job, tid.stage_id),
+            attrs={
+                "stage_id": tid.stage_id,
+                "partition": tid.partition_id,
+                "duration_s": round(dur, 4),
+                "stage_median_s": round(med, 4),
+            },
+        )
+        log.warning(
+            "straggler: task %s/%s/%s took %.3fs (stage median %.3fs, "
+            "factor %.1f)",
+            tid.job_id, tid.stage_id, tid.partition_id, dur, med,
+            cfg.straggler_factor(),
+        )
+
+    def _detect_skew(self, job: JobInfo, stage_id: int) -> None:
+        """Skew monitor (docs/observability.md): when a stage completes,
+        compare each (stage, partition)'s processed rows — the max
+        output_rows across its shipped per-operator metrics, i.e. the
+        widest point of the fragment — against the stage median. Flagged
+        partitions are EXACTLY the candidates the AQE split policy
+        (ROADMAP) will feed to SplitShufflePartitions."""
+        cfg = self._session_config(job.session_id)
+        ratio = cfg.skew_ratio()
+        if ratio <= 0:
+            return
+        with self._lock:
+            rows_by_part: dict[int, float] = {}
+            for (sid, part), records in job.op_metrics.items():
+                if sid != stage_id:
+                    continue
+                widest = 0.0
+                for r in records:
+                    v = r.get("counters", {}).get("output_rows")
+                    if isinstance(v, (int, float)):
+                        widest = max(widest, float(v))
+                rows_by_part[part] = widest
+        if len(rows_by_part) < 2:
+            return
+        import statistics
+
+        med = statistics.median(rows_by_part.values())
+        if med <= 0:
+            return
+        floor = cfg.skew_min_rows()
+        for part in sorted(rows_by_part):
+            rows = rows_by_part[part]
+            if rows < floor or rows <= ratio * med:
+                continue
+            with self._lock:
+                if (stage_id, part) in job.skew_flags:
+                    continue
+                job.skew_flags.append((stage_id, part))
+                self.obs_skew_total[job.query_class] = (
+                    self.obs_skew_total.get(job.query_class, 0) + 1
+                )
+            self._job_event(
+                job, "skew",
+                parent_id=self._stage_span_id(job, stage_id),
+                attrs={
+                    "stage_id": stage_id,
+                    "partition": part,
+                    "rows": int(rows),
+                    "stage_median_rows": int(med),
+                },
+            )
+            log.warning(
+                "skew: partition %s/%s/%s processed %d rows "
+                "(stage median %d, ratio %.1f)",
+                job.job_id, stage_id, part, int(rows), int(med), ratio,
+            )
+
+    def desired_executors(self) -> int:
+        """The composite autoscale pressure the KEDA ExternalScaler
+        reports (docs/observability.md): base demand = inflight tasks
+        over per-executor slots, scaled up (capped 4x) when the p90 of
+        recent queue waits exceeds the declared target — pending work
+        alone under-scales when jobs are stacking up faster than slots
+        free. Also served as the ballista_desired_executors gauge."""
+        import math
+
+        inflight = self.stage_manager.inflight_tasks()
+        if inflight <= 0:
+            return 0
+        em = self.executor_manager
+        per_exec = 0
+        for eid in sorted(em.tracked_executors()):
+            data = em.get_executor_data(eid)
+            if data is not None:
+                per_exec = max(per_exec, data.total_task_slots)
+        per_exec = per_exec or 4
+        base = math.ceil(inflight / per_exec)
+        target = self.config.scaler_queue_wait_target_s()
+        import time as _time
+
+        cutoff = _time.time() - self.queue_wait_window_s
+        with self._lock:
+            # recency-filtered: stale burst-era waits must stop driving
+            # the multiplier once the queue has actually drained
+            waits = sorted(
+                w for at, w in self._recent_queue_waits if at >= cutoff
+            )
+        if waits and target > 0:
+            p90 = waits[min(len(waits) - 1, int(0.9 * (len(waits) - 1)))]
+            if p90 > target:
+                base = math.ceil(base * min(p90 / target, 4.0))
+        return max(base, 1)
 
     def job_stats(self, job_id: str) -> dict | None:
         """Aggregated per-stage / per-partition stats for one job (the
@@ -881,6 +1141,10 @@ class SchedulerServer:
         if job is None:
             return
         self._finish_stage_span(job, stage_id)
+        # skew monitor (docs/observability.md): every task of this stage
+        # has reported — its shipped per-partition metrics are complete,
+        # so the rows-vs-median comparison is meaningful exactly now
+        self._detect_skew(job, stage_id)
         deferred: list = []
         promoted: list[int] = []
         # sorted: parents_of returns a set, and promote/event order should
@@ -1230,6 +1494,17 @@ class SchedulerServer:
             flat.extend(part)
         job.completed_locations = flat
         job.status = "completed"
+        # the final stage has no StageFinished event (JobFinished fires
+        # instead) — run its skew check here so the last stage's
+        # partitions are monitored like every other stage's
+        self._detect_skew(job, job.final_stage_id)
+        # fleet plane: end-to-end latency by query class
+        if job.submitted_s:
+            import time as _time
+
+            self._h_job_latency.labels(job.query_class).observe(
+                max(0.0, _time.time() - job.submitted_s)
+            )
         if self.state is not None:
             self.state.save_job(job)
         # observability: stats + trace snapshot BEFORE the stage teardown
@@ -1393,7 +1668,24 @@ class SchedulerServer:
             self.event_loop.post(failure)
             return None
         cfg = self._session_config(job.session_id)
+        # queue-wait metering (docs/observability.md): the FIRST task
+        # assignment of a job closes its submit->assignment gap — the
+        # admission/backpressure signal the composite autoscale pressure
+        # and the SLO harness read
+        import time as _time
+
+        now = _time.time()
+        with self._lock:
+            first_assign = job.first_assign_s == 0.0
+            if first_assign:
+                job.first_assign_s = now
+        if first_assign and job.submitted_s:
+            wait = max(0.0, now - job.submitted_s)
+            self._h_queue_wait.labels(job.query_class).observe(wait)
+            with self._lock:
+                self._recent_queue_waits.append((now, wait))
         from ballista_tpu.config import (
+            BALLISTA_INTERNAL_QUERY_CLASS,
             BALLISTA_INTERNAL_SPAN_PARENT,
             BALLISTA_INTERNAL_TASK_ATTEMPT,
             BALLISTA_INTERNAL_TRACE_ID,
@@ -1405,10 +1697,14 @@ class SchedulerServer:
         ] + [
             # task-scoped (NOT session config; executors strip the
             # ballista.internal. prefix before building BallistaConfig):
-            # the attempt number keys fault injection and retry logging
+            # the attempt number keys fault injection and retry logging;
+            # the query class labels the executor's task-run histogram
             pb.KeyValuePair(
                 key=BALLISTA_INTERNAL_TASK_ATTEMPT, value=str(attempt)
-            )
+            ),
+            pb.KeyValuePair(
+                key=BALLISTA_INTERNAL_QUERY_CLASS, value=job.query_class
+            ),
         ]
         if job.trace_id:
             # distributed tracing (docs/observability.md): the trace id
@@ -1609,6 +1905,23 @@ class SchedulerServer:
                 self._ingest_task_metrics(
                     tid.job_id, tid.stage_id, tid.partition_id, st
                 )
+                # fleet plane: stage-task duration histogram + the
+                # straggler check, both off the just-closed window.
+                # Guarded: an escaping metering exception here would
+                # abort the RPC AFTER update_task_status already applied
+                # the transition — the executor's retry then replays a
+                # now-illegal COMPLETED->COMPLETED hop that returns no
+                # events, so the StageFinished/JobFinished generated
+                # above would be lost FOREVER and the job would wedge
+                # "running" (observed: a NameError in the straggler log
+                # line wedged every straggler-flagging run).
+                try:
+                    self._observe_task_completion(tid)
+                except Exception:  # noqa: BLE001 — metering must never
+                    # outrank the terminal events it rides along with
+                    log.exception(
+                        "task-completion metering failed for %s", tid
+                    )
             elif kind == "failed":
                 error = st.failed.error
                 # a ShuffleFetchError carries the SOURCE of the lost data;
@@ -1772,6 +2085,7 @@ class SchedulerGrpcServicer:
                 )
             )
         self.s.ingest_spans(list(request.spans))
+        self.s.ingest_hists(list(request.hists))
         self.s.apply_task_statuses(list(request.task_status))
         result = pb.PollWorkResult()
         if request.can_accept_task:
@@ -1833,6 +2147,7 @@ class SchedulerGrpcServicer:
             {kv.key: float(kv.value) for kv in request.metrics},
         )
         self.s.ingest_spans(list(request.spans))
+        self.s.ingest_hists(list(request.hists))
         # an executor the expiry sweep dropped (or a scheduler that restarted
         # without its registration) must re-register to get slots back
         reregister = (

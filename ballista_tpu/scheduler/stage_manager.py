@@ -52,6 +52,19 @@ class TaskInfo:
     # this task failed on or was lost from (handout prefers others)
     attempts: int = 0
     blamed: set[str] = dataclasses.field(default_factory=set)
+    # fleet observability (docs/observability.md): wall-clock bounds of
+    # the CURRENT attempt (stamped on the RUNNING / terminal transitions;
+    # a requeue resets them) — the timeline endpoint's Gantt source and
+    # the straggler monitor's duration input
+    started_s: float = 0.0
+    ended_s: float = 0.0
+    # flagged by the straggler monitor (duration > k x stage median)
+    straggler: bool = False
+    # this attempt window was already fed to the duration histogram —
+    # replayed COMPLETED statuses (a lost PollWork response makes the
+    # executor resend; the transition replay is rejected as illegal)
+    # must not observe the same window twice
+    duration_metered: bool = False
 
 
 @dataclasses.dataclass
@@ -119,6 +132,28 @@ class TaskRescheduled(StageEvent):
     partition_id: int
     attempt: int
     error: str
+
+
+def straggler_stats(
+    durations: list[float], factor: float, min_s: float
+) -> tuple[float, float] | None:
+    """``(threshold, median)`` for the straggler monitor over a stage's
+    completed task durations, or None when no meaningful threshold
+    exists (monitor disabled, fewer than 3 completions to form a
+    median, or a zero median). ONE definition shared by the committing
+    check (SchedulerServer._observe_task_completion) and the timeline's
+    live projection (rest.job_timeline) — two hand-synced copies once
+    disagreed on the median convention, making the Gantt view and the
+    Prometheus counter contradict each other about the same task. The
+    median rides along so flag sites don't sort the list twice."""
+    import statistics
+
+    if factor <= 0 or len(durations) < 3:
+        return None
+    med = statistics.median(durations)
+    if med <= 0:
+        return None
+    return max(min_s, factor * med), med
 
 
 class StageManager:
@@ -412,6 +447,20 @@ class StageManager:
             if (info.state, new_state) not in _LEGAL:
                 return []
             blamed_executor = executor_id or info.executor_id
+            import time as _time
+
+            # attempt wall-clock bounds (timeline + straggler monitor):
+            # RUNNING opens a fresh window, terminal states close it, and
+            # any PENDING re-open (requeue, invalidation) clears it
+            if new_state == TaskState.RUNNING:
+                info.started_s = _time.time()
+                info.ended_s = 0.0
+            elif new_state in (TaskState.COMPLETED, TaskState.FAILED):
+                info.ended_s = _time.time()
+            elif new_state == TaskState.PENDING:
+                info.started_s = 0.0
+                info.ended_s = 0.0
+                info.duration_metered = False
             info.state = new_state
             info.executor_id = executor_id or info.executor_id
             info.error = error
@@ -442,6 +491,9 @@ class StageManager:
                     # transition the reference declares but never takes)
                     info.state = TaskState.PENDING
                     info.executor_id = ""
+                    info.started_s = 0.0
+                    info.ended_s = 0.0
+                    info.duration_metered = False
                     events.append(
                         TaskRescheduled(
                             task_id.job_id,
@@ -524,6 +576,9 @@ class StageManager:
                     t.blamed.add(t.executor_id)
                     t.executor_id = ""
                     t.partitions = []
+                    t.started_s = 0.0
+                    t.ended_s = 0.0
+                    t.duration_metered = False
                     out.append(PartitionId(job_id, stage_id, i))
             if out:
                 stage.recomputes += 1
@@ -624,6 +679,58 @@ class StageManager:
                 )
             ]
 
+    def take_unmetered_runtime(
+        self, job_id: str, stage_id: int, partition: int
+    ) -> float | None:
+        """Duration (seconds) of a task's CURRENT closed attempt window,
+        consumed EXACTLY ONCE (atomic under the lock): a replayed
+        COMPLETED status — the executor resends after a lost RPC
+        response, and the transition replay is rejected — gets None, so
+        the stage-task histogram never double-counts one window. A
+        PENDING re-open clears the flag with the window (a genuine new
+        attempt meters again)."""
+        with self._lock:
+            stage = self._stages.get((job_id, stage_id))
+            if stage is None or not (0 <= partition < stage.n_tasks):
+                return None
+            t = stage.tasks[partition]
+            if t.duration_metered or not (t.started_s and t.ended_s):
+                return None
+            t.duration_metered = True
+            return max(0.0, t.ended_s - t.started_s)
+
+    def completed_durations(
+        self, job_id: str, stage_id: int
+    ) -> list[float]:
+        """Closed-attempt durations of this stage's COMPLETED tasks (the
+        straggler monitor's median base)."""
+        with self._lock:
+            stage = self._stages.get((job_id, stage_id))
+            if stage is None:
+                return []
+            return [
+                t.ended_s - t.started_s
+                for t in stage.tasks
+                if t.state == TaskState.COMPLETED
+                and t.started_s
+                and t.ended_s
+            ]
+
+    def mark_straggler(
+        self, job_id: str, stage_id: int, partition: int
+    ) -> bool:
+        """Flag one task as a straggler (idempotent; returns whether the
+        flag was newly set — the counter increments only once)."""
+        with self._lock:
+            stage = self._stages.get((job_id, stage_id))
+            if stage is None or not (0 <= partition < stage.n_tasks):
+                return False
+            t = stage.tasks[partition]
+            if t.straggler:
+                return False
+            t.straggler = True
+            return True
+
     def stage_recomputes(self, job_id: str, stage_id: int) -> int:
         with self._lock:
             stage = self._stages.get((job_id, stage_id))
@@ -683,6 +790,9 @@ class StageManager:
                         # task did nothing wrong
                         t.blamed.add(t.executor_id)
                         t.executor_id = ""
+                        t.started_s = 0.0
+                        t.ended_s = 0.0
+                        t.duration_metered = False
                         out.append(PartitionId(job_id, stage_id, i))
         return out
 
@@ -754,6 +864,12 @@ class StageManager:
                             "output_batches": sum(
                                 m.num_batches for m in t.partitions
                             ),
+                            # timeline (docs/observability.md): the
+                            # current attempt's wall-clock window + the
+                            # straggler-monitor flag
+                            "started_s": round(t.started_s, 6),
+                            "ended_s": round(t.ended_s, 6),
+                            "straggler": t.straggler,
                         }
                     )
                 out.append(

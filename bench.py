@@ -121,6 +121,24 @@ def _peak_rss_mb() -> float:
     )
 
 
+def _percentiles(xs) -> dict:
+    """Nearest-rank p50/p95/p99 over a latency sample (tail tracking:
+    means hide exactly the latencies an SLO cares about). Keys match the
+    per-query BENCH_* plan-artifact fields."""
+    s = sorted(xs)
+
+    def pct(p: float) -> float:
+        if not s:
+            return 0.0
+        return s[min(len(s) - 1, int(round(p * (len(s) - 1))))]
+
+    return {
+        "p50": round(pct(0.50), 4),
+        "p95": round(pct(0.95), 4),
+        "p99": round(pct(0.99), 4),
+    }
+
+
 _PLAN_COUNTERS = (
     "spill_bytes", "spill_passes", "stream_slices",
     "prefetch_hits", "prefetch_misses",
@@ -206,10 +224,16 @@ def run_suite() -> dict:
                 _, nrows, phys = _collect_with_plan(ctx, sql)
                 warms.append(time.time() - t0)
         counters = _plan_counters(phys)
+        warm_pcts = _percentiles(warms)
         q = {
             "cold_s": round(cold, 4),
             "warm_s": [round(w, 4) for w in warms],
             "warm_best_s": round(min(warms), 4),
+            # tail tracking across repeats (docs/observability.md): the
+            # perf trajectory keeps tails, not just bests/averages
+            "warm_p50_s": warm_pcts["p50"],
+            "warm_p95_s": warm_pcts["p95"],
+            "warm_p99_s": warm_pcts["p99"],
             "rows": nrows,
             "lineitem_rows_per_s": int(rows["lineitem"] / min(warms)),
             # tracked compile-cost fields (BENCH_* plan schema): future
@@ -562,6 +586,296 @@ def run_shuffle_suite() -> dict:
     return out
 
 
+def run_slo_suite() -> dict:
+    """BENCH_SLO=1: the sustained-QPS SLO harness (ISSUE 12 /
+    docs/observability.md). Drives a MIXED small/large TPC-H workload at
+    a target arrival rate (open-loop: submissions fire on the clock, not
+    on completions — the regime where queues actually form) against a
+    2-executor standalone cluster, twice:
+
+    - **steady** — no faults; the baseline distribution.
+    - **chaos** — one executor killed (shuffle files deleted) mid-round
+      while submissions keep arriving; lineage recovery + bounded
+      retries must keep completing queries, and the cost shows up in the
+      TAIL, which is exactly what this artifact exists to measure.
+
+    Verdicts come from the scheduler's OWN metrics plane: after the
+    rounds the harness scrapes ``/api/metrics`` (validated at the
+    exposition-parser level), reads the ``ballista_job_latency_seconds``
+    / ``ballista_queue_wait_seconds`` histograms per query class, and
+    renders p50/p99 + queue-wait-p90 SLO verdicts against declared
+    targets. Client-observed per-round latencies are reported alongside
+    (they include result fetch; the server series starts at submission).
+    ``ballista_spans_dropped_total`` must be 0 — the run itself proves
+    the no-silent-caps rule held under load.
+
+    Env: BENCH_SLO_SF (default 0.05), BENCH_SLO_QPS (default 2),
+    BENCH_SLO_SECONDS (per round, default 25), BENCH_SLO_SMALL /
+    BENCH_SLO_LARGE (query names, default q6 / q3),
+    BENCH_SLO_TARGET_SMALL_P99_S / _LARGE_P99_S /
+    BENCH_SLO_TARGET_QUEUE_P90_S. Writes BENCH_SLO.json.
+    """
+    import re
+    import threading
+    import urllib.request
+
+    import numpy as np  # noqa: F401 — table gen path below
+
+    from ballista_tpu.client.context import BallistaContext
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.obs.hist import quantile_from_cumulative
+    from ballista_tpu.scheduler.rest import (
+        start_rest_server,
+        stop_rest_server,
+    )
+    from ballista_tpu.tpch import gen_all
+
+    sf = float(os.environ.get("BENCH_SLO_SF", "0.05"))
+    qps = float(os.environ.get("BENCH_SLO_QPS", "2"))
+    round_s = float(os.environ.get("BENCH_SLO_SECONDS", "25"))
+    small_q = os.environ.get("BENCH_SLO_SMALL", "q6")
+    large_q = os.environ.get("BENCH_SLO_LARGE", "q3")
+    targets = {
+        "small_p99_s": float(
+            os.environ.get("BENCH_SLO_TARGET_SMALL_P99_S", "10")
+        ),
+        "large_p99_s": float(
+            os.environ.get("BENCH_SLO_TARGET_LARGE_P99_S", "20")
+        ),
+        "queue_wait_p90_s": float(
+            os.environ.get("BENCH_SLO_TARGET_QUEUE_P90_S", "2")
+        ),
+    }
+    sqls = {
+        "small": (QDIR / f"{small_q}.sql").read_text(),
+        "large": (QDIR / f"{large_q}.sql").read_text(),
+    }
+    # arrival mix: 2 small : 1 large (interactive-heavy, like a real
+    # serving tier)
+    mix = ("small", "small", "large")
+
+    cfg = (
+        BallistaConfig()
+        .with_setting("ballista.shuffle.partitions", "2")
+        .with_setting("ballista.tpu.task_max_attempts", "4")
+    )
+    data = gen_all(scale=sf)
+    ctx = BallistaContext.standalone(
+        cfg,
+        n_executors=2,
+        # tight liveness so the chaos round's expiry/recovery fits the
+        # round instead of a 60s default window
+        executor_timeout_s=5.0,
+        expiry_check_interval_s=1.0,
+    )
+    sched = ctx._standalone_cluster.scheduler
+    httpd, rest_port = start_rest_server(sched, "127.0.0.1", 0)
+    base = f"http://127.0.0.1:{rest_port}"
+    out = {
+        "sf": sf,
+        "qps": qps,
+        "round_seconds": round_s,
+        "mix": {"small": small_q, "large": large_q, "arrivals": list(mix)},
+        "targets": targets,
+        "rounds": {},
+    }
+    try:
+        for name, t in data.items():
+            ctx.register_table(name, t)
+        # warmup (compile + caches) and class-token discovery: the
+        # scheduler labels series by the opaque qclass hash; map it back
+        # to small/large via the warmup jobs
+        class_token = {}
+        for cls in ("small", "large"):
+            ctx.sql(sqls[cls]).collect()
+            with sched._lock:
+                latest = max(
+                    sched.jobs.values(), key=lambda j: j.submitted_s
+                )
+            class_token[cls] = latest.query_class
+            ctx.sql(sqls[cls]).collect()  # one more fully-warm pass
+        assert class_token["small"] != class_token["large"]
+        out["query_class_tokens"] = class_token
+
+        lock = threading.Lock()
+
+        def run_round(chaos: bool) -> dict:
+            results: list[tuple] = []  # (class, latency_s, ok)
+            threads: list[tuple] = []  # (thread, class)
+
+            def one(cls: str) -> None:
+                t0 = time.time()
+                ok = True
+                try:
+                    ctx.sql(sqls[cls]).collect()
+                except Exception:  # noqa: BLE001 — the SLO artifact
+                    # reports failures; it must not die on one
+                    ok = False
+                with lock:
+                    results.append((cls, time.time() - t0, ok))
+
+            killed = None
+            t_start = time.time()
+            i = 0
+            while time.time() - t_start < round_s:
+                due = t_start + i / qps
+                now = time.time()
+                if due > now:
+                    time.sleep(due - now)
+                cls = mix[i % len(mix)]
+                th = threading.Thread(target=one, args=(cls,))
+                th.start()
+                threads.append((th, cls))
+                i += 1
+                if chaos and killed is None and cls == "large" and (
+                    time.time() - t_start >= 0.4 * round_s
+                ):
+                    # mid-round executor kill, timed right after a LARGE
+                    # query entered flight so its multi-stage work is
+                    # guaranteed to straddle the crash: loops stop,
+                    # Flight dies, shuffle files are deleted — the full
+                    # crashed-machine shape while load keeps arriving.
+                    # Recovery (expiry sweep -> task reset + lost-shuffle
+                    # recompute) must surface in the TAIL, not in failed
+                    # queries.
+                    time.sleep(min(0.3, 1.0 / qps))
+                    killed = ctx._standalone_cluster.kill_executor(
+                        1, lose_shuffle=True
+                    )
+            for th, _cls in threads:
+                th.join(timeout=300)
+            # a thread still alive after the join deadline is a HUNG
+            # query — exactly the recovery failure this harness exists
+            # to catch; it must count as failed, not silently vanish
+            # from both the completed and failed tallies
+            hung = {"small": 0, "large": 0}
+            for th, cls in threads:
+                if th.is_alive():
+                    hung[cls] += 1
+            with lock:
+                got = list(results)
+            rnd: dict = {"submitted": i}
+            for cls in ("small", "large"):
+                lat_ok = [l for c, l, ok in got if c == cls and ok]
+                failed = sum(
+                    1 for c, _l, ok in got if c == cls and not ok
+                ) + hung[cls]
+                rnd[cls] = {
+                    "completed": len(lat_ok),
+                    "failed": failed,
+                    "hung": hung[cls],
+                    "client_latency_s": _percentiles(lat_ok),
+                }
+            if chaos:
+                state = json.load(
+                    urllib.request.urlopen(base + "/api/state")
+                )
+                rnd["killed_executor"] = killed
+                rnd["retries_total"] = sum(
+                    j["retries"] for j in state["jobs"]
+                )
+                rnd["recomputes_total"] = sum(
+                    j["recomputes"] for j in state["jobs"]
+                )
+            return rnd
+
+        out["rounds"]["steady"] = run_round(chaos=False)
+        out["rounds"]["chaos"] = run_round(chaos=True)
+
+        # -- scrape + verdicts (parser-level validated) --------------------
+        from ballista_tpu.obs.prometheus import validate_exposition
+
+        text = urllib.request.urlopen(base + "/api/metrics").read().decode()
+        validate_exposition(text)
+        out["scrape"] = _scrape_hist_quantiles(
+            text, class_token, quantile_from_cumulative
+        )
+        dropped = sum(
+            float(m.group(1))
+            for m in re.finditer(
+                r"^ballista_spans_dropped_total\{[^}]*\} ([0-9.e+-]+)$",
+                text, re.M,
+            )
+        )
+        out["spans_dropped_total"] = int(dropped)
+        sc = out["scrape"]
+        chaos_failed = (
+            out["rounds"]["chaos"]["small"]["failed"]
+            + out["rounds"]["chaos"]["large"]["failed"]
+        )
+        out["slo"] = {
+            "small_p99_s": sc["job_latency"]["small"]["p99"],
+            "small_p99_ok": (
+                sc["job_latency"]["small"]["p99"] <= targets["small_p99_s"]
+            ),
+            "large_p99_s": sc["job_latency"]["large"]["p99"],
+            "large_p99_ok": (
+                sc["job_latency"]["large"]["p99"] <= targets["large_p99_s"]
+            ),
+            "queue_wait_p90_s": sc["queue_wait"]["all"]["p90"],
+            "queue_wait_p90_ok": (
+                sc["queue_wait"]["all"]["p90"]
+                <= targets["queue_wait_p90_s"]
+            ),
+            "chaos_all_completed": chaos_failed == 0,
+            "spans_dropped_ok": dropped == 0,
+        }
+        out["slo"]["pass"] = all(
+            v for k, v in out["slo"].items() if k.endswith("_ok")
+            or k == "chaos_all_completed"
+        )
+    finally:
+        stop_rest_server(httpd)
+        ctx.close()
+    return out
+
+
+def _scrape_hist_quantiles(text: str, class_token: dict, qfn) -> dict:
+    """p50/p90/p99 per query class from scraped ``_bucket`` samples —
+    computed with the same interpolation the in-process histograms use."""
+    import math
+    import re
+
+    bucket_re = re.compile(
+        r"^(ballista_[a-z_]+_seconds)_bucket\{([^}]*)\} ([0-9.e+-]+|\+?Inf)$",
+        re.M,
+    )
+    series: dict = {}
+    for m in bucket_re.finditer(text):
+        name, labels, value = m.group(1), m.group(2), float(m.group(3))
+        lab = dict(
+            kv.split("=", 1) for kv in labels.split(",") if "=" in kv
+        )
+        le_raw = lab.get("le", "").strip('"')
+        le = math.inf if le_raw == "+Inf" else float(le_raw)
+        cls = lab.get("class", "").strip('"')
+        series.setdefault((name, cls), []).append((le, value))
+    token_class = {v: k for k, v in class_token.items()}
+    out: dict = {"job_latency": {}, "queue_wait": {}}
+    for (name, cls), pairs in sorted(series.items()):
+        if name == "ballista_job_latency_seconds":
+            label = token_class.get(cls)
+            if label:
+                out["job_latency"][label] = {
+                    "p50": round(qfn(pairs, 0.50), 4),
+                    "p99": round(qfn(pairs, 0.99), 4),
+                    "count": int(max(v for _le, v in pairs)),
+                }
+        elif name == "ballista_queue_wait_seconds":
+            merged = out["queue_wait"].setdefault("_pairs", {})
+            for le, v in pairs:
+                merged[le] = merged.get(le, 0.0) + v
+    merged = out["queue_wait"].pop("_pairs", {})
+    pairs = sorted(merged.items())
+    out["queue_wait"]["all"] = {
+        "p50": round(qfn(pairs, 0.50), 4),
+        "p90": round(qfn(pairs, 0.90), 4),
+        "p99": round(qfn(pairs, 0.99), 4),
+        "count": int(max((v for _le, v in pairs), default=0)),
+    }
+    return out
+
+
 def run_compile_suite() -> dict:
     """BENCH_COMPILE=1: the cold-start suite (ISSUE 7 /
     docs/compile_cache.md). Measures, per tracked query and for the whole
@@ -875,6 +1189,25 @@ def _run_child(env: dict, iters: int, timeout: int, label: str):
 
 
 def main() -> None:
+    if os.environ.get("BENCH_SLO"):
+        # sustained-QPS SLO harness (docs/observability.md): in-process
+        # standalone cluster + open-loop load + /api/metrics verdicts
+        sys.path.insert(0, str(HERE))
+        res = run_slo_suite()
+        (HERE / "BENCH_SLO.json").write_text(json.dumps(res, indent=2))
+        print(json.dumps(res, indent=2), file=sys.stderr)
+        print(json.dumps({
+            "metric": (
+                f"slo_sf{res['sf']:g}_qps{res['qps']:g}_"
+                f"{res['mix']['small']}_{res['mix']['large']}"
+            ),
+            "value": res["slo"]["large_p99_s"],
+            "unit": "p99_seconds",
+            "slo_pass": res["slo"]["pass"],
+            "queue_wait_p90_s": res["slo"]["queue_wait_p90_s"],
+            "spans_dropped_total": res["spans_dropped_total"],
+        }))
+        return
     if os.environ.get("BENCH_SHUFFLE"):
         # shuffle data-plane suite: self-contained, host-path dominated —
         # runs in-process and writes its own artifact

@@ -1,0 +1,714 @@
+"""Fleet-level observability (ISSUE 12, docs/observability.md).
+
+Unit coverage for the histogram primitive (observe/merge/quantile,
+Prometheus ``histogram`` exposition at parser level, the exactly-once
+delta-shipping seam, proto round-trip), the trace-store drop counters
+(no-silent-caps), the event-loop dispatch-lag hook, query-class
+fingerprints, the straggler/skew monitors and the timeline endpoint at
+the scheduler level, and the KEDA ExternalScaler's composite-pressure
+contract — plus one distributed acceptance subprocess: a seeded-skew
+join with a fetch_slow-delayed partition must be flagged by BOTH
+monitors in the Prometheus counters and the /api/job/<id>/timeline
+response.
+"""
+
+import json
+import math
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ballista_tpu.obs import hist as obs_hist
+from ballista_tpu.obs import prometheus as prom
+from ballista_tpu.obs import trace as obs_trace
+
+from tests.conftest import CPU_MESH_ENV
+
+_HELP_RE = re.compile(r"^# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
+_TYPE_RE = re.compile(
+    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (gauge|counter|histogram)$"
+)
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\})?"
+    r" -?[0-9.e+-]+$"
+)
+
+
+def parse_exposition(text: str) -> dict:
+    out: dict = {}
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if line.startswith("# HELP"):
+            assert _HELP_RE.match(line), line
+            continue
+        if line.startswith("# TYPE"):
+            assert _TYPE_RE.match(line), line
+            continue
+        assert _SAMPLE_RE.match(line), f"invalid sample line: {line!r}"
+        name = re.split(r"[{ ]", line, 1)[0]
+        out.setdefault(name, []).append(line)
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs_trace.clear()
+    obs_trace.enable_shipping(False)
+    obs_hist.REGISTRY.clear()
+    yield
+    obs_trace.clear()
+    obs_trace.enable_shipping(False)
+    obs_hist.REGISTRY.clear()
+
+
+# ---------------------------------------------------------------------------
+# histogram primitive
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_observe_quantile_and_bounds():
+    reg = obs_hist.Registry("t")
+    h = reg.histogram("ballista_x_seconds", "x", ("class",)).labels("a")
+    for v in (0.003, 0.003, 0.003, 0.1):
+        h.observe(v)
+    counts, total_sum, count = h.snapshot()
+    assert count == 4 and abs(total_sum - 0.109) < 1e-9
+    assert sum(counts) == 4
+    # the p50 estimate lands inside the bucket containing 0.003
+    assert 0.002 <= h.quantile(0.5) <= 0.004
+    # p99 lands in 0.1's bucket
+    assert 0.05 <= h.quantile(0.99) <= 0.128
+    # out-of-range huge values go to +Inf; quantile clamps to top bound
+    h.observe(10**9)
+    assert h.quantile(1.0) == h.buckets[-1]
+    # empty histogram answers 0
+    empty = reg.histogram("ballista_y_seconds", "y").labels()
+    assert empty.quantile(0.99) == 0.0
+
+
+def test_histogram_label_arity_is_enforced():
+    reg = obs_hist.Registry("t")
+    vec = reg.histogram("ballista_x_seconds", "x", ("class",))
+    with pytest.raises(ValueError):
+        vec.labels("a", "b")
+    with pytest.raises(ValueError):
+        reg.histogram("ballista_x_seconds", "x", ("other",))
+
+
+def test_histogram_families_are_valid_exposition():
+    reg = obs_hist.Registry("t")
+    reg.histogram(
+        "ballista_x_seconds", "x latencies", ("class",)
+    ).labels("q1").observe(0.02)
+    text = prom.render(reg.families())
+    parsed = parse_exposition(text)
+    buckets = parsed["ballista_x_seconds_bucket"]
+    assert any('le="+Inf"' in line for line in buckets)
+    # cumulative: the +Inf bucket equals _count
+    assert parsed["ballista_x_seconds_count"][0].endswith(" 1")
+    assert "ballista_x_seconds_sum" in parsed
+    # le values ascend within the series
+    les = [
+        float(m.group(1))
+        for m in (
+            re.search(r'le="([0-9.e+-]+)"', line) for line in buckets
+        )
+        if m
+    ]
+    assert les == sorted(les)
+
+
+def test_drain_deltas_exactly_once_and_requeue():
+    reg = obs_hist.Registry("t")
+    h = reg.histogram("ballista_x_seconds", "x", ("class",)).labels("a")
+    h.observe(0.01)
+    first = reg.drain_deltas()
+    assert len(first) == 1 and first[0]["count"] == 1
+    # nothing new: second drain is empty
+    assert reg.drain_deltas() == []
+    h.observe(0.02)
+    second = reg.drain_deltas()
+    assert second[0]["count"] == 1
+    # a failed ship requeues; the next drain re-includes it plus new
+    reg.requeue_deltas(second)
+    h.observe(0.04)
+    third = reg.drain_deltas()
+    assert sum(d["count"] for d in third) == 2
+    # cumulative totals were never affected by shipping bookkeeping
+    assert h.count == 3
+    # repeated requeues COMPACT by (name, labels, buckets): an extended
+    # scheduler outage must not grow the outbox one record per failed
+    # poll (deltas are additive)
+    reg.requeue_deltas(third)
+    h.observe(0.08)
+    reg.requeue_deltas(reg.drain_deltas())
+    with reg._lock:
+        assert len(reg._outbox) == 1, reg._outbox
+    final = reg.drain_deltas()
+    assert len(final) == 1 and final[0]["count"] == 3
+    assert abs(final[0]["sum"] - (0.01 + 0.02 + 0.04 + 0.08 - 0.01)) < 1e-9
+
+
+def test_deltas_proto_roundtrip_and_scheduler_ingest():
+    reg = obs_hist.Registry("src")
+    reg.histogram(
+        "ballista_executor_task_run_seconds", "runs", ("class",)
+    ).labels("q5").observe(0.25)
+    deltas = reg.drain_deltas()
+    protos = obs_hist.deltas_to_proto(deltas)
+    back = obs_hist.deltas_from_proto(protos)
+    assert back[0]["name"] == "ballista_executor_task_run_seconds"
+    assert back[0]["labels"] == {"class": "q5"}
+    assert back[0]["count"] == 1
+    dst = obs_hist.Registry("dst")
+    dst.ingest(back)
+    dst.ingest(back)  # a second identical delta adds again (it is a delta)
+    child = dst.get("ballista_executor_task_run_seconds").labels("q5")
+    assert child.count == 2
+    assert abs(child.sum - 0.5) < 1e-9
+
+
+def test_ingest_rejects_bucket_layout_mismatch():
+    """A version-skewed executor shipping a different bucket ladder must
+    be rejected loudly, never merged into the wrong bounds (silent
+    quantile corruption)."""
+    dst = obs_hist.Registry("dst")
+    good = {
+        "name": "ballista_x_seconds", "labels": {}, "help": "x",
+        "buckets": [0.1, 1.0], "counts": [1, 0, 0], "sum": 0.05,
+        "count": 1,
+    }
+    dst.ingest([good])
+    bad = dict(good, buckets=[0.1, 1.0, 10.0], counts=[0, 0, 1, 0])
+    with pytest.raises(ValueError):
+        dst.ingest([bad])
+    # batch atomicity: a good record arriving in the SAME batch as a bad
+    # one must not be half-applied (the caller logs the batch as dropped)
+    with pytest.raises(ValueError):
+        dst.ingest([good, bad])
+    assert dst.get("ballista_x_seconds").labels().count == 1
+    # the scheduler-side wrapper drops the batch without poisoning the
+    # liveness RPC
+    from ballista_tpu.proto import pb  # noqa: F401 — proto import path
+
+    server = _server()
+    try:
+        server.ingest_hists(obs_hist.deltas_to_proto([good]))
+        server.ingest_hists(obs_hist.deltas_to_proto([bad]))  # no raise
+        child = server.hists.get("ballista_x_seconds").labels()
+        assert child.count == 1  # bad batch dropped, good one kept
+    finally:
+        server.shutdown()
+
+
+def test_quantile_from_cumulative_matches_histogram():
+    reg = obs_hist.Registry("t")
+    h = reg.histogram("ballista_x_seconds", "x").labels()
+    for v in (0.004, 0.009, 0.03, 0.3, 1.2, 2.5):
+        h.observe(v)
+    counts, _s, total = h.snapshot()
+    pairs, cum = [], 0
+    for i, le in enumerate(h.buckets):
+        cum += counts[i]
+        pairs.append((le, cum))
+    pairs.append((math.inf, total))
+    for q in (0.5, 0.9, 0.99):
+        assert abs(
+            obs_hist.quantile_from_cumulative(pairs, q) - h.quantile(q)
+        ) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# trace-store drop accounting (no-silent-caps)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_overflow_is_counted():
+    assert obs_trace.dropped() == {"ring": 0, "outbox": 0}
+    tid = obs_trace.new_trace_id()
+    for i in range(obs_trace._RING_CAP + 7):
+        obs_trace.event(f"e{i}", trace_id=tid)
+    assert obs_trace.dropped()["ring"] == 7
+    obs_trace.clear()
+    assert obs_trace.dropped() == {"ring": 0, "outbox": 0}
+
+
+def test_outbox_overflow_and_requeue_overflow_are_counted():
+    obs_trace.enable_shipping(True)
+    tid = obs_trace.new_trace_id()
+    for i in range(obs_trace._OUTBOX_CAP + 3):
+        obs_trace.event(f"e{i}", trace_id=tid)
+    assert obs_trace.dropped()["outbox"] == 3
+    drained = obs_trace.drain_outbox()
+    assert len(drained) == obs_trace._OUTBOX_CAP
+    # refill the outbox, then requeue the full drained batch on top:
+    # the overflow past capacity is LOST and must be counted
+    for i in range(10):
+        obs_trace.event(f"r{i}", trace_id=tid)
+    before = obs_trace.dropped()["outbox"]
+    obs_trace.requeue_outbox(drained)
+    assert obs_trace.dropped()["outbox"] == before + 10
+
+
+# ---------------------------------------------------------------------------
+# event-loop dispatch lag
+# ---------------------------------------------------------------------------
+
+
+def test_event_loop_lag_callback_fires():
+    from ballista_tpu.event_loop import EventAction, EventLoop
+
+    seen = []
+
+    class _A(EventAction):
+        def on_receive(self, event):
+            seen.append(event)
+            return None
+
+    loop = EventLoop("lag-test", _A())
+    lags = []
+    loop.lag_cb = lags.append
+    loop.start()
+    try:
+        loop.post("x")
+        loop.drain(timeout=5)
+    finally:
+        loop.stop()
+    assert seen == ["x"]
+    assert len(lags) == 1 and 0 <= lags[0] < 5
+
+
+# ---------------------------------------------------------------------------
+# query-class fingerprints
+# ---------------------------------------------------------------------------
+
+
+def test_query_class_stable_and_distinct():
+    import pyarrow as pa
+
+    from ballista_tpu.exec.context import TpuContext
+    from ballista_tpu.obs.qclass import plan_class
+
+    ctx = TpuContext()
+    ctx.register_table("t", pa.table({"k": [1, 2], "v": [1.0, 2.0]}))
+
+    def phys(sql):
+        df = ctx.sql(sql)
+        from ballista_tpu.exec.planner import PhysicalPlanner
+        from ballista_tpu.plan.optimizer import optimize
+
+        return PhysicalPlanner(ctx, 2, config=ctx.config).plan(
+            optimize(df.logical)
+        )
+
+    a1 = plan_class(phys("select k, sum(v) s from t group by k"))
+    a2 = plan_class(phys("select k, sum(v) s from t group by k"))
+    b = plan_class(phys("select k from t where v > 1.5"))
+    assert a1 == a2
+    assert a1 != b
+    assert re.fullmatch(r"[0-9a-f]{8}", a1)
+    # literal normalization: the same TEMPLATE with a different constant
+    # is the same class (a parameterized serving workload must not mint
+    # one class — one never-evicted histogram-label set — per literal)
+    b2 = plan_class(phys("select k from t where v > 99.25"))
+    assert b2 == b
+
+
+def test_query_class_cardinality_is_capped():
+    """Beyond max_query_classes, new shapes aggregate under 'overflow'
+    (counted) instead of minting unbounded histogram label sets."""
+    import pyarrow as pa
+
+    from ballista_tpu.exec.context import TpuContext
+    from ballista_tpu.exec.planner import PhysicalPlanner
+    from ballista_tpu.plan.optimizer import optimize
+
+    ctx = TpuContext()
+    ctx.register_table("t", pa.table({"k": [1, 2], "v": [1.0, 2.0]}))
+
+    def phys(sql):
+        return PhysicalPlanner(ctx, 2, config=ctx.config).plan(
+            optimize(ctx.sql(sql).logical)
+        )
+
+    server = _server()
+    try:
+        server.max_query_classes = 1
+        j1 = server.submit_physical(phys("select k from t"), "s")
+        j2 = server.submit_physical(
+            phys("select k, sum(v) s from t group by k"), "s"
+        )
+        j3 = server.submit_physical(phys("select k from t"), "s")
+        with server._lock:
+            classes = [server.jobs[j].query_class for j in (j1, j2, j3)]
+            overflow = server.obs_class_overflow
+        assert classes[0] != "overflow"
+        assert classes[1] == "overflow"
+        assert classes[2] == classes[0]  # known class keeps its label
+        assert overflow == 1
+        text = prom.render(prom.scheduler_families(server))
+        assert "ballista_query_class_overflow_total 1" in text
+        assert "ballista_query_classes 1" in text
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# straggler / skew monitors + timeline (scheduler level)
+# ---------------------------------------------------------------------------
+
+
+def _server():
+    from ballista_tpu.scheduler.server import SchedulerServer
+
+    return SchedulerServer(provider=None, expiry_check_interval_s=3600)
+
+
+def _fake_job(server, job_id="jfleet", qclass="qc1"):
+    from ballista_tpu.scheduler.server import JobInfo
+
+    job = JobInfo(job_id=job_id, session_id="s")
+    job.query_class = qclass
+    job.submitted_s = time.time() - 1.0
+    job.status = "running"
+    with server._lock:
+        server.jobs[job_id] = job
+    return job
+
+
+def test_straggler_monitor_flags_slow_task():
+    from ballista_tpu.scheduler.stage_manager import TaskState
+    from ballista_tpu.scheduler_types import PartitionId
+
+    server = _server()
+    try:
+        job = _fake_job(server)
+        sm = server.stage_manager
+        sm.add_running_stage(job.job_id, 1, 4)
+        now = time.time()
+        stage = sm.get_stage(job.job_id, 1)
+        # three fast completions, one 10x outlier
+        for i, dur in enumerate((0.5, 0.6, 0.55, 6.0)):
+            t = stage.tasks[i]
+            t.state = TaskState.COMPLETED
+            t.started_s = now - dur
+            t.ended_s = now
+        for i in range(4):
+            server._observe_task_completion(
+                PartitionId(job.job_id, 1, i)
+            )
+        with server._lock:
+            flagged = dict(server.obs_straggler_total)
+        assert flagged == {"qc1": 1}
+        assert stage.tasks[3].straggler and not stage.tasks[0].straggler
+        # stage-task histogram recorded all four durations
+        child = server._h_stage_task.labels("qc1", "1")
+        assert child.count == 4
+        # replayed COMPLETED statuses (executor resend after a lost RPC
+        # response) must not re-observe the same attempt windows
+        for i in range(4):
+            server._observe_task_completion(
+                PartitionId(job.job_id, 1, i)
+            )
+        assert child.count == 4
+        # counter appears in the exposition
+        text = prom.render(prom.scheduler_families(server))
+        parsed = parse_exposition(text)
+        assert any(
+            'class="qc1"' in line and line.endswith(" 1")
+            for line in parsed["ballista_stragglers_total"]
+        )
+        # timeline carries the flag
+        from ballista_tpu.scheduler.rest import job_timeline
+
+        tl = job_timeline(server, job.job_id)
+        flags = {
+            (t["stage_id"], t["partition"]): t["straggler"]
+            for t in tl["tasks"]
+        }
+        assert flags[(1, 3)] is True and flags[(1, 0)] is False
+        assert job_timeline(server, "nope") is None
+    finally:
+        server.shutdown()
+
+
+def test_straggler_monitor_respects_floor_and_median_minimum():
+    from ballista_tpu.scheduler.stage_manager import TaskState
+    from ballista_tpu.scheduler_types import PartitionId
+
+    server = _server()
+    try:
+        job = _fake_job(server)
+        sm = server.stage_manager
+        sm.add_running_stage(job.job_id, 4, 4)
+        now = time.time()
+        stage = sm.get_stage(job.job_id, 4)
+        # 4x over the median but UNDER the 1s noise floor: not flagged
+        for i, dur in enumerate((0.01, 0.01, 0.012, 0.2)):
+            t = stage.tasks[i]
+            t.state = TaskState.COMPLETED
+            t.started_s = now - dur
+            t.ended_s = now
+            server._observe_task_completion(
+                PartitionId(job.job_id, 4, i)
+            )
+        with server._lock:
+            assert server.obs_straggler_total == {}
+    finally:
+        server.shutdown()
+
+
+def test_skew_monitor_flags_wide_partition():
+    server = _server()
+    try:
+        job = _fake_job(server, qclass="qc2")
+        # per-(stage, partition) shipped metrics: partition 2 is 10x the
+        # median — the AQE split candidate
+        with server._lock:
+            for part, rows in ((0, 5000), (1, 6000), (2, 60000),
+                               (3, 5500)):
+                job.op_metrics[(3, part)] = [
+                    {"counters": {"output_rows": rows,
+                                  "output_bytes": rows * 8}}
+                ]
+        server._detect_skew(job, 3)
+        assert job.skew_flags == [(3, 2)]
+        with server._lock:
+            assert server.obs_skew_total == {"qc2": 1}
+        # idempotent: re-running the check never double-counts
+        server._detect_skew(job, 3)
+        assert job.skew_flags == [(3, 2)]
+        with server._lock:
+            assert server.obs_skew_total == {"qc2": 1}
+        text = prom.render(prom.scheduler_families(server))
+        assert 'ballista_skew_partitions_total{class="qc2"} 1' in text
+        # below the min_rows floor nothing is flagged
+        job2 = _fake_job(server, job_id="jtiny", qclass="qc3")
+        with server._lock:
+            for part, rows in ((0, 10), (1, 11), (2, 400)):
+                job2.op_metrics[(1, part)] = [
+                    {"counters": {"output_rows": rows}}
+                ]
+        server._detect_skew(job2, 1)
+        assert job2.skew_flags == []
+    finally:
+        server.shutdown()
+
+
+def test_scheduler_families_include_fleet_series_and_are_valid():
+    server = _server()
+    try:
+        server._h_job_latency.labels("qc").observe(0.5)
+        server._h_queue_wait.labels("qc").observe(0.05)
+        text = prom.render(prom.scheduler_families(server))
+        parsed = parse_exposition(text)
+        for required in (
+            "ballista_job_latency_seconds_bucket",
+            "ballista_job_latency_seconds_sum",
+            "ballista_job_latency_seconds_count",
+            "ballista_queue_wait_seconds_bucket",
+            "ballista_spans_dropped_total",
+            "ballista_desired_executors",
+            "ballista_stragglers_total",
+            "ballista_skew_partitions_total",
+        ):
+            assert required in parsed, required
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# KEDA external scaler: composite pressure
+# ---------------------------------------------------------------------------
+
+
+def test_external_scaler_contract_and_composite_pressure():
+    from ballista_tpu.scheduler.external_scaler import (
+        COMPOSITE_PRESSURE_METRIC_NAME,
+        ExternalScalerServicer,
+    )
+    from ballista_tpu.proto import pb
+    from ballista_tpu.scheduler_types import ExecutorData
+
+    server = _server()
+    try:
+        svc = ExternalScalerServicer(server)
+        ref = pb.ScaledObjectRef(name="x", namespace="default")
+        req = pb.GetMetricsRequest(scaledObjectRef=ref)
+
+        # idle cluster: inactive, zero pressure
+        assert svc.IsActive(ref, None).result is False
+        assert svc.GetMetrics(req, None).metricValues[0].metricValue == 0
+
+        spec = svc.GetMetricSpec(ref, None).metricSpecs[0]
+        assert spec.metricName == COMPOSITE_PRESSURE_METRIC_NAME
+        assert spec.targetSize == 1
+
+        # scaled-to-zero fix: PENDING tasks alone (no executor could be
+        # RUNNING anything) must read active and ask for capacity
+        server.stage_manager.add_running_stage("job1", 1, 8)
+        assert svc.IsActive(ref, None).result is True
+        v = svc.GetMetrics(req, None).metricValues[0]
+        assert v.metricName == COMPOSITE_PRESSURE_METRIC_NAME
+        # no executor registered: default 4 slots/executor -> ceil(8/4)
+        assert v.metricValue == 2
+
+        # a registered 8-slot executor halves the demand
+        server.executor_manager.save_executor_data(
+            ExecutorData("e1", 8, 8)
+        )
+        assert svc.GetMetrics(req, None).metricValues[0].metricValue == 1
+
+        # back-compat: a ScaledObject pinning the pre-PR-12 name keeps
+        # raw-inflight semantics under that name
+        legacy = svc.GetMetrics(
+            pb.GetMetricsRequest(metricName="inflight_tasks"), None
+        )
+        assert legacy.metricValues[0].metricName == "inflight_tasks"
+        assert legacy.metricValues[0].metricValue == 8
+
+        # queue-wait pressure: p90 over target scales the ask (capped 4x)
+        target = server.config.scaler_queue_wait_target_s()
+        now = time.time()
+        with server._lock:
+            server._recent_queue_waits.extend([(now, target * 3)] * 20)
+        assert svc.GetMetrics(req, None).metricValues[0].metricValue == 3
+        with server._lock:
+            server._recent_queue_waits.clear()
+            server._recent_queue_waits.extend([(now, target * 100)] * 20)
+        assert svc.GetMetrics(req, None).metricValues[0].metricValue == 4
+        # recency window: burst-era waits older than the window stop
+        # driving the multiplier once the queue has drained
+        stale = now - server.queue_wait_window_s - 1
+        with server._lock:
+            server._recent_queue_waits.clear()
+            server._recent_queue_waits.extend([(stale, target * 100)] * 20)
+        assert svc.GetMetrics(req, None).metricValues[0].metricValue == 1
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# distributed acceptance: seeded skew + fetch_slow straggler
+# ---------------------------------------------------------------------------
+
+SKEW_STRAGGLER_SCRIPT = r"""
+import json, time, urllib.request
+import numpy as np
+import pyarrow as pa
+
+from ballista_tpu.client.context import BallistaContext
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.scheduler.rest import start_rest_server, stop_rest_server
+from ballista_tpu.testing import faults
+
+cfg = (
+    BallistaConfig()
+    .with_setting("ballista.shuffle.partitions", "4")
+    # real multi-partition shuffle stages: the mesh collective path fuses
+    # the whole query into ONE single-task stage (all_to_all inside),
+    # which leaves nothing partition-level for the monitors to compare
+    .with_setting("ballista.tpu.collective_shuffle", "false")
+    .with_setting("ballista.tpu.trace", "on")
+    .with_setting("ballista.tpu.straggler_factor", "2")
+    .with_setting("ballista.tpu.straggler_min_s", "0.5")
+    .with_setting("ballista.tpu.skew_ratio", "2")
+    .with_setting("ballista.tpu.skew_min_rows", "1000")
+)
+ctx = BallistaContext.standalone(cfg, n_executors=2)
+try:
+    n = 40000
+    r = np.random.default_rng(7)
+    # seeded skew: 80% of fact rows share one join key; a join preserves
+    # row counts through the shuffle (unlike a partial-agg stage), so the
+    # partition that key hashes into is the known-skewed one
+    keys = np.where(r.uniform(size=n) < 0.8, 7, r.integers(0, 40, n))
+    ctx.register_table("fact", pa.table({
+        "k": pa.array(keys.astype(np.int64)),
+        "v": pa.array(r.uniform(0, 10, n)),
+    }))
+    ctx.register_table("dim", pa.table({
+        "k": pa.array(np.arange(40, dtype=np.int64)),
+        "w": pa.array(r.uniform(0, 1, 40)),
+    }))
+    sched = ctx._standalone_cluster.scheduler
+    httpd, port = start_rest_server(sched, "127.0.0.1", 0)
+    base = f"http://127.0.0.1:{port}"
+    sql = ("select f.k, sum(f.v * d.w) s from fact f "
+           "join dim d on f.k = d.k group by f.k")
+    # cold run first (compile noise would poison the duration medians)
+    t = ctx.sql(sql).collect()
+    assert t.num_rows == 40, t.num_rows
+    # slow every fetch of partition 0 from here on: the warm run's
+    # partition-0 consumer tasks stall ~per-location while their stage
+    # siblings finish fast -> the straggler monitor must flag them
+    faults.install([
+        {"point": "fetch_slow", "partition": 0, "delay_s": 1.0,
+         "max_fires": 8},
+    ])
+    t = ctx.sql(sql).collect()
+    assert t.num_rows == 40, t.num_rows
+    faults.install(None)
+    with sched._lock:
+        warm_job = max(sched.jobs.values(), key=lambda j: j.submitted_s)
+    # scheduler-side flags
+    assert warm_job.skew_flags, "skew monitor flagged nothing"
+    # Prometheus counters (scraped, parser-visible)
+    text = urllib.request.urlopen(base + "/api/metrics").read().decode()
+    def counter_total(name):
+        tot = 0.0
+        for line in text.splitlines():
+            if line.startswith(name) and not line.startswith("#"):
+                tot += float(line.rsplit(" ", 1)[1])
+        return tot
+    assert counter_total("ballista_stragglers_total") >= 1, "no straggler counter"
+    assert counter_total("ballista_skew_partitions_total") >= 1, "no skew counter"
+    # timeline response: the slowed partition-0 task is flagged, and the
+    # known-skewed partition is marked
+    tl = json.load(urllib.request.urlopen(
+        base + f"/api/job/{warm_job.job_id}/timeline"))
+    assert tl["query_class"] == warm_job.query_class
+    stragglers = [t for t in tl["tasks"] if t["straggler"]]
+    assert stragglers, "timeline shows no straggler"
+    assert any(t["partition"] == 0 for t in stragglers), stragglers
+    skewed = [t for t in tl["tasks"] if t["skewed"]]
+    assert skewed, "timeline shows no skewed partition"
+    # the flagged partition really is the widest one of its stage
+    with sched._lock:
+        om = dict(warm_job.op_metrics)
+    sid, part = warm_job.skew_flags[0]
+    def width(p):
+        return max((r["counters"].get("output_rows", 0)
+                    for r in om.get((sid, p), [{"counters": {}}])),
+                   default=0)
+    widths = {p: width(p) for s, p in om if s == sid}
+    assert width(part) == max(widths.values()), (part, widths)
+    # trace events made it into the job's span store
+    names = {s.name for s in warm_job.spans.values()}
+    assert "skew" in names, names
+    assert "straggler" in names, names
+    stop_rest_server(httpd)
+    print("FLEET-OK")
+finally:
+    ctx.close()
+"""
+
+
+def test_skew_and_straggler_flagged_distributed():
+    """Acceptance (ISSUE 12): the seeded-skew partition is flagged by
+    the skew monitor and a fetch_slow-delayed task by the straggler
+    monitor — visible in the Prometheus counters AND the timeline."""
+    proc = subprocess.run(
+        [sys.executable, "-c", SKEW_STRAGGLER_SCRIPT],
+        env=CPU_MESH_ENV,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + "\n" + proc.stderr
+    assert "FLEET-OK" in proc.stdout

@@ -220,10 +220,14 @@ print("ENTRYPOINT-OK")
     ch = grpc.insecure_channel(f"127.0.0.1:{sched_port}")
     stub = _Stub(ch, EXTERNAL_SCALER_SERVICE, EXTERNAL_SCALER_METHODS)
     spec = stub.GetMetricSpec(pb.ScaledObjectRef(name="x", namespace="d"))
-    assert spec.metricSpecs[0].metricName == "inflight_tasks"
+    # PR 12 (docs/observability.md): the scale signal is the composite
+    # desired-executor pressure, not the raw inflight count
+    assert spec.metricSpecs[0].metricName == "desired_executors"
     assert spec.metricSpecs[0].targetSize == 1
     active = stub.IsActive(pb.ScaledObjectRef(name="x", namespace="d"))
     assert active.result is False  # job finished, nothing running
-    metrics = stub.GetMetrics(pb.GetMetricsRequest(metricName="inflight_tasks"))
+    metrics = stub.GetMetrics(
+        pb.GetMetricsRequest(metricName="desired_executors")
+    )
     assert metrics.metricValues[0].metricValue == 0
     ch.close()
