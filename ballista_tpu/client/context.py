@@ -23,7 +23,6 @@ from ballista_tpu.exec.context import DataFrame, TpuContext
 from ballista_tpu.plan.logical import LogicalPlan
 from ballista_tpu.proto import pb
 from ballista_tpu.scheduler.rpc import scheduler_stub
-from ballista_tpu.scheduler_types import PartitionLocation
 from ballista_tpu.serde import logical_to_proto
 from ballista_tpu.sql import ast
 from ballista_tpu.sql.parser import parse_sql
@@ -185,19 +184,22 @@ class BallistaContext(TpuContext):
         # flattening to batches for the single from_batches below copies
         # nothing.
         from ballista_tpu.analysis import replay
+        from ballista_tpu.columnar.coalesce import BatchCoalescer
         from ballista_tpu.executor.reader import fetch_partition_table
+        from ballista_tpu.serde import loc_from_proto
 
+        # tiny-batch coalescing (columnar/coalesce.py): wide shuffles
+        # deliver results as fan-out slivers, and from_batches over
+        # thousands of them pays per-batch fixed costs twice (once per
+        # chunk here, once per chunk in every downstream consumer of the
+        # chunked table) — fold them to the shuffle target size first,
+        # with the same helper both shuffle ends use
+        coalescer = BatchCoalescer(
+            self.config.shuffle_target_batch_mb() << 20
+        )
         batches = []
         for loc_p in completed.partition_location:
-            loc = PartitionLocation(
-                job_id=loc_p.partition_id.job_id,
-                stage_id=loc_p.partition_id.stage_id,
-                partition=loc_p.partition_id.partition_id,
-                executor_id=loc_p.executor_meta.id,
-                host=loc_p.executor_meta.host,
-                port=loc_p.executor_meta.port,
-                path=loc_p.path,
-            )
+            loc = loc_from_proto(loc_p)
             t = fetch_partition_table(loc)
             if replay.enabled():
                 # replay witness: every final result partition records a
@@ -209,7 +211,13 @@ class BallistaContext(TpuContext):
                     replay.canonical_hash(t),
                 )
             if t.num_rows:
-                batches.extend(t.to_batches())
+                for rb in t.to_batches():
+                    out = coalescer.add(rb)
+                    if out is not None:
+                        batches.append(out)
+        tail = coalescer.flush()
+        if tail is not None:
+            batches.append(tail)
         if not batches:
             from ballista_tpu.columnar.arrow_interop import schema_to_arrow
             from ballista_tpu.plan.optimizer import optimize

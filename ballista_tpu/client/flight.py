@@ -149,18 +149,19 @@ def backoff_s(loc: PartitionLocation, attempt: int, backoff_ms: int) -> float:
     return base * jitter
 
 
-def make_ticket(
+def make_fetch_action(
     loc: PartitionLocation,
     compression: str = "",
     trace_ctx: tuple[str, str] | None = None,
-) -> paflight.Ticket:
-    """``compression`` (none|lz4|zstd) rides the Action's settings so the
-    SERVING executor compresses the Flight stream's IPC buffers — the
-    session's ballista.tpu.shuffle_compression applied to bytes on the
-    wire, not just bytes on disk. Empty = server streams uncompressed.
-    ``trace_ctx`` (trace_id, parent span id) rides the settings too, so
-    the serving executor's flight_serve span joins the consumer's trace
-    (docs/observability.md)."""
+) -> pb.Action:
+    """The FetchPartition action shared by the pull ticket (``do_get``)
+    and the push descriptor (``do_exchange``). ``compression``
+    (none|lz4|zstd) rides the Action's settings so the SERVING executor
+    compresses the Flight stream's IPC buffers — the per-link negotiated
+    codec applied to bytes on the wire, not just bytes on disk. Empty =
+    server streams uncompressed. ``trace_ctx`` (trace_id, parent span id)
+    rides the settings too, so the serving executor's flight_serve span
+    joins the consumer's trace (docs/observability.md)."""
     from ballista_tpu.config import (
         BALLISTA_INTERNAL_SPAN_PARENT,
         BALLISTA_INTERNAL_TRACE_ID,
@@ -185,16 +186,28 @@ def make_ticket(
                 key=BALLISTA_INTERNAL_SPAN_PARENT, value=trace_ctx[1]
             )
         )
-    action = pb.Action(
+    return pb.Action(
         fetch_partition=pb.FetchPartition(
             job_id=loc.job_id,
             stage_id=loc.stage_id,
             partition_id=loc.partition,
             path=loc.path,
+            map_partition=loc.map_partition,
+            push=loc.push,
         ),
         settings=settings,
     )
-    return paflight.Ticket(action.SerializeToString())
+
+
+def make_ticket(
+    loc: PartitionLocation,
+    compression: str = "",
+    trace_ctx: tuple[str, str] | None = None,
+) -> paflight.Ticket:
+    """do_get ticket: the serialized fetch action."""
+    return paflight.Ticket(
+        make_fetch_action(loc, compression, trace_ctx).SerializeToString()
+    )
 
 
 def _call_options(timeout_s: float) -> paflight.FlightCallOptions:
@@ -341,3 +354,123 @@ def fetch_partition_batches(
             # the shuffle file is gone). Redialing cannot help; recomputing
             # the producing stage can.
             raise _escalate(loc, e, transient=False) from e
+
+
+def fetch_push_batches(
+    loc: PartitionLocation,
+    retries: int | None = None,
+    backoff_ms: int | None = None,
+    timeout_s: float | None = None,
+    compression: str = "",
+    trace_ctx: tuple[str, str] | None = None,
+    on_fallback=None,
+):
+    """Stream a push-shuffle partition over Flight ``do_exchange``
+    (docs/shuffle.md): the serving executor writes the live in-memory
+    stream when it has one and transparently serves the spilled file
+    otherwise — its first message is an app-metadata tag (``mem`` /
+    ``file``); ``on_fallback`` fires when the tag says the push window
+    already spilled this stream (the consumer effectively took the pull
+    path over the exchange call).
+
+    Resilience matches :func:`fetch_partition_batches`: transient
+    transport errors redial with bounded backoff while nothing was
+    yielded; a ``[push-stream-gone]`` server error (producer lost the
+    stream AND its fall-back file) is non-transient — the typed
+    ShuffleFetchError it escalates to names the producing executor, and
+    the scheduler recomputes the lost map output."""
+    retries = DEFAULT_FETCH_RETRIES if retries is None else max(1, retries)
+    backoff_ms = (
+        DEFAULT_FETCH_BACKOFF_MS if backoff_ms is None else backoff_ms
+    )
+    timeout_s = DEFAULT_FETCH_TIMEOUT_S if timeout_s is None else timeout_s
+
+    action = make_fetch_action(loc, compression, trace_ctx)
+    descriptor = paflight.FlightDescriptor.for_command(
+        action.SerializeToString()
+    )
+    yielded = False
+    for attempt in range(retries):
+        client = None
+        reader = None
+        try:
+            _inject_fetch_fault(loc, attempt)
+            client = _client_for(loc.host, loc.port)
+            writer, reader = client.do_exchange(
+                descriptor, options=_call_options(timeout_s)
+            )
+            try:
+                # consumer->producer half unused: close it so the server
+                # handler is not left waiting on our writes
+                writer.done_writing()
+                while True:
+                    try:
+                        chunk = reader.read_chunk()
+                    except StopIteration:
+                        break
+                    if chunk.data is None:
+                        if (
+                            on_fallback is not None
+                            and chunk.app_metadata is not None
+                            and chunk.app_metadata.to_pybytes() == b"file"
+                        ):
+                            on_fallback()
+                        continue
+                    yielded = True
+                    yield chunk.data
+            finally:
+                with contextlib.suppress(Exception):
+                    reader.cancel()
+                with contextlib.suppress(Exception):
+                    writer.close()
+            return
+        except _TRANSIENT_FLIGHT_ERRORS as e:
+            if client is not None:
+                _evict(loc.host, loc.port, client)
+            if yielded or attempt + 1 >= retries:
+                # mid-stream loss of a push stream is unrecoverable by
+                # redialing (take-once memory): escalate to the typed
+                # error that drives producer recompute
+                raise _escalate(loc, e, transient=True) from e
+            time.sleep(backoff_s(loc, attempt, backoff_ms))
+        except ShuffleFetchError:
+            raise
+        except (paflight.FlightError, pa.ArrowInvalid, pa.ArrowIOError) as e:
+            # includes the machine-parseable [push-stream-gone] server
+            # error: the stream is dead, only lineage recompute helps
+            raise _escalate(loc, e, transient=False) from e
+
+
+def fetch_push_partition(
+    loc: PartitionLocation,
+    retries: int | None = None,
+    backoff_ms: int | None = None,
+    timeout_s: float | None = None,
+) -> pa.Table:
+    """Materialize one push partition (result fetches). The batch list is
+    private to each attempt and discarded on a transient retry — the same
+    atomic-per-location retry contract :func:`fetch_partition` gives the
+    client result path (nothing flows downstream mid-attempt)."""
+    retries = DEFAULT_FETCH_RETRIES if retries is None else max(1, retries)
+    for attempt in range(retries):
+        try:
+            batches = list(
+                fetch_push_batches(
+                    loc, retries=1, backoff_ms=backoff_ms,
+                    timeout_s=timeout_s,
+                )
+            )
+            return pa.Table.from_batches(batches) if batches else (
+                pa.Table.from_batches([], schema=pa.schema([]))
+            )
+        except ShuffleFetchError as e:
+            if not e.transient or attempt + 1 >= retries:
+                raise
+            time.sleep(
+                backoff_s(
+                    loc, attempt,
+                    DEFAULT_FETCH_BACKOFF_MS
+                    if backoff_ms is None else backoff_ms,
+                )
+            )
+    raise AssertionError("unreachable")  # pragma: no cover

@@ -227,11 +227,28 @@ class HintStore:
             return True
 
 
+def _snapshot_items(d: dict) -> list:
+    """Stable snapshot of a dict OTHER task threads mutate concurrently:
+    ``list(d.items())`` itself raises RuntimeError when the dict resizes
+    mid-construction (observed live — two task-runner threads on one
+    executor, one fingerprinting its save while the other committed its
+    attempt cache; the bounded task retry masked it as a spurious task
+    failure). Retrying is cheap and converges: resizes are rare single
+    events, not a steady state. The empty-list give-up (never observed)
+    at worst skips/doubles one debounced hint write — both correct."""
+    for _ in range(8):
+        try:
+            return list(d.items())
+        except RuntimeError:
+            continue
+    return []
+
+
 def _persistable(plan_cache: dict):
     """Yield (repr-key, repr-value) for every entry that survives the
     literal_eval round trip, newest-biased to _MAX_ENTRIES
     (``agg_capacity`` is a separate top-level document field)."""
-    items = list(plan_cache.items())
+    items = _snapshot_items(plan_cache)
     if len(items) > _MAX_ENTRIES:
         items = items[-_MAX_ENTRIES:]
     for k, v in items:
@@ -260,8 +277,9 @@ def _fingerprint(hint: dict, plan_cache: dict) -> int:
     items = []
     # snapshot first: the executor's task threads mutate this dict
     # concurrently with a finishing task's save (repr() between loop
-    # steps can yield the GIL mid-iteration)
-    for k, v in list(plan_cache.items()):
+    # steps can yield the GIL mid-iteration, and the list() itself must
+    # survive a concurrent resize — _snapshot_items)
+    for k, v in _snapshot_items(plan_cache):
         if k in _EPHEMERAL_KEYS:
             continue
         items.append((repr(_canon(k)), repr(_canon(v))))

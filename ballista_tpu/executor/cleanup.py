@@ -85,6 +85,21 @@ def clean_spill_data(ttl_seconds: float, root: str | None = None) -> list[str]:
     return deleted
 
 
+def clean_push_streams(ttl_seconds: float) -> int:
+    """Drop sealed push-shuffle streams (executor/push.py) idle for
+    longer than the TTL — the in-memory analogue of the job-dir sweep,
+    on the same horizon: a stream this stale belongs to a job whose
+    files would be swept too (consumer crashed for good, job failed),
+    and recovery recomputes if anyone ever asks again. Returns the
+    count dropped."""
+    from ballista_tpu.executor.push import REGISTRY
+
+    n = REGISTRY.sweep(ttl_seconds)
+    if n:
+        log.info("cleaned %d expired push streams", n)
+    return n
+
+
 def start_cleanup_loop(
     work_dir: str,
     ttl_seconds: float,
@@ -99,6 +114,7 @@ def start_cleanup_loop(
             try:
                 clean_shuffle_data(work_dir, ttl_seconds)
                 clean_spill_data(ttl_seconds)
+                clean_push_streams(ttl_seconds)
             except Exception:  # noqa: BLE001
                 log.exception("shuffle cleanup sweep failed")
 

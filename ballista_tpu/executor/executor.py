@@ -350,7 +350,14 @@ class Executor:
             # lineage recompute, or certified rewrite re-recording the
             # same key must hash identically. Only successful attempts
             # reach here, so failed attempts' partial files never record.
+            # Push-committed partitions hash their in-memory batches with
+            # the SAME canonical hash a file read produces (batch-
+            # boundary/codec/residency invariant), so push-vs-pull
+            # re-records of one key compare equal by construction.
             for m in out:
+                digest = self._committed_hash(task, m)
+                if digest is None:
+                    continue
                 replay.record(
                     "shuffle",
                     (
@@ -359,13 +366,45 @@ class Executor:
                         task.task_id.partition_id,
                         m.partition_id,
                     ),
-                    replay.hash_file(m.path),
+                    digest,
                 )
         op_metrics = collector.record_stage(
             task.task_id.job_id, task.task_id.stage_id,
             task.task_id.partition_id, plan,
         )
         return TaskRunOutput(partitions=out, operator_metrics=op_metrics)
+
+    @staticmethod
+    def _committed_hash(task: pb.TaskDefinition, m) -> str | None:
+        """Replay-witness hash of one committed shuffle partition: the
+        in-memory push stream when it lives there, else the file. None
+        means DON'T record: a non-empty commit that hashes as absent can
+        only mean the data plane was torn down beneath this task between
+        its commit and this read-back (executor kill racing the task
+        thread — drop_owner emptied the registry and the work dir is
+        gone). That commit is unobservable without a lineage recompute,
+        and the recompute's re-record is the hash that matters; recording
+        "empty" here would fabricate a mismatch for a row set nobody can
+        ever consume."""
+        from ballista_tpu.analysis import replay
+
+        if getattr(m, "push", False):
+            from ballista_tpu.executor.push import REGISTRY, stream_key
+
+            batches = REGISTRY.peek_batches(
+                stream_key(
+                    task.task_id.job_id, task.task_id.stage_id,
+                    task.task_id.partition_id, m.partition_id,
+                )
+            )
+            if batches:
+                import pyarrow as pa
+
+                return replay.canonical_hash(pa.Table.from_batches(batches))
+        digest = replay.hash_file(m.path)
+        if digest == "empty" and m.num_rows > 0:
+            return None
+        return digest
 
 
 @dataclasses.dataclass
@@ -404,6 +443,7 @@ def as_task_status(
                     num_batches=m.num_batches,
                     num_rows=m.num_rows,
                     num_bytes=m.num_bytes,
+                    push=getattr(m, "push", False),
                 )
                 for m in result
             ],
@@ -475,6 +515,13 @@ class PollLoop:
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.executor.close_locations_client()
+        # push-shuffle streams die with their producer by design
+        # (docs/shuffle.md): drop this executor's registry entries so
+        # consumers fall back / recompute and the memory (and resource-
+        # witness entries) drain to zero at shutdown
+        from ballista_tpu.executor.push import REGISTRY
+
+        REGISTRY.drop_owner(self.executor.work_dir)
 
     def _metadata(self) -> pb.ExecutorMetadata:
         return pb.ExecutorMetadata(

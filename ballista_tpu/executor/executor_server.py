@@ -291,6 +291,10 @@ class ExecutorServer:
         # (close_locations_client also latches against exactly that race
         # for stragglers that outlived the join timeout)
         self.executor.close_locations_client()
+        # push-shuffle streams die with their producer (docs/shuffle.md)
+        from ballista_tpu.executor.push import REGISTRY
+
+        REGISTRY.drop_owner(self.executor.work_dir)
         if self._grpc_server is not None:
             ev = self._grpc_server.stop(grace=None)
             if ev is not None:

@@ -1,9 +1,10 @@
 """Arrow Flight server: the executor's shuffle data plane.
 
-ref ballista/rust/executor/src/flight_service.rs:55-245 — only ``do_get``
-is implemented (FetchPartition tickets -> stream the Arrow IPC file); all
-other Flight verbs are unimplemented, exactly like the reference
-(:119-184). pyarrow.flight is Arrow C++ underneath.
+ref ballista/rust/executor/src/flight_service.rs:55-245 — ``do_get``
+(FetchPartition tickets -> stream the Arrow IPC file) plus ``do_exchange``
+for the push-shuffle fast path (docs/shuffle.md); the remaining Flight
+verbs are unimplemented, exactly like the reference (:119-184).
+pyarrow.flight is Arrow C++ underneath.
 
 Hardening/perf on top of the reference shape (docs/shuffle.md):
 
@@ -13,11 +14,21 @@ Hardening/perf on top of the reference shape (docs/shuffle.md):
   plane can serve shuffle output, never /etc/passwd.
 - **Stream compression**: a ticket carrying
   ``ballista.tpu.shuffle_compression`` in its Action settings gets the
-  stream's IPC buffers compressed with that codec (lz4|zstd) — cheaper
-  bytes over the NIC regardless of how the file was written.
-- The file is served batch-at-a-time off a memory map (read_all() held
-  the whole partition in server memory, an OOM at SF=100 widths;
-  uncompressed files now stream zero-copy from the page cache).
+  stream's IPC buffers compressed with that codec (lz4|zstd) — the
+  consumer negotiates it per link (none when colocated, lz4 over a NIC).
+- **Zero-copy serving**: files are served batch-at-a-time off a memory
+  map — uncompressed batches alias the page cache straight into the
+  Flight serializer, no per-request heap copy of the partition (the
+  buffered pa.OSFile read this replaces was the dominant per-batch CPU
+  cost BENCH_SHUFFLE measured on fast links; the map is closed
+  deterministically, so RSS exposure is bounded by the in-flight
+  stream, not by request history).
+- **DoExchange push streams**: a FetchPartition action in the descriptor
+  command (with ``push``/``map_partition``) serves the in-memory push
+  registry when the stream is live, transparently falling back to the
+  spilled file at the same path; a stream that is neither in memory nor
+  on disk raises the machine-parseable ``[push-stream-gone]`` error the
+  consumer escalates into lineage recompute.
 """
 
 from __future__ import annotations
@@ -32,6 +43,33 @@ import pyarrow.ipc as paipc
 from ballista_tpu.proto import pb
 
 _STREAM_CODECS = ("lz4", "zstd")
+
+# machine-parseable marker (client/flight.py classifies it non-transient:
+# redialing cannot resurrect a dead push stream; recomputing the producer
+# can)
+PUSH_GONE = "[push-stream-gone]"
+
+
+def _parse_action(raw: bytes) -> pb.Action:
+    action = pb.Action()
+    action.ParseFromString(raw)
+    kind = action.WhichOneof("action_type")
+    if kind != "fetch_partition":
+        raise paflight.FlightServerError(
+            f"unsupported action {kind!r} (ref flight_service.rs:110-117)"
+        )
+    return action
+
+
+def _stream_options(settings: dict) -> paipc.IpcWriteOptions | None:
+    from ballista_tpu.config import BALLISTA_SHUFFLE_COMPRESSION
+
+    codec = settings.get(BALLISTA_SHUFFLE_COMPRESSION, "")
+    return (
+        paipc.IpcWriteOptions(compression=codec)
+        if codec in _STREAM_CODECS
+        else None
+    )
 
 
 class BallistaFlightService(paflight.FlightServerBase):
@@ -54,54 +92,59 @@ class BallistaFlightService(paflight.FlightServerBase):
             )
         return real
 
-    def do_get(self, context, ticket: paflight.Ticket):
-        action = pb.Action()
-        action.ParseFromString(ticket.ticket)
-        kind = action.WhichOneof("action_type")
-        if kind != "fetch_partition":
-            raise paflight.FlightServerError(
-                f"unsupported action {kind!r} (ref flight_service.rs:110-117)"
-            )
-        fp = action.fetch_partition
-        path = self._contained_path(fp.path)
-
+    @staticmethod
+    def _serve_span(settings: dict, fp, push: bool):
         from ballista_tpu.config import (
             BALLISTA_INTERNAL_SPAN_PARENT,
             BALLISTA_INTERNAL_TRACE_ID,
-            BALLISTA_SHUFFLE_COMPRESSION,
         )
 
-        settings = {kv.key: kv.value for kv in action.settings}
-        codec = settings.get(BALLISTA_SHUFFLE_COMPRESSION, "")
+        trace_id = settings.get(BALLISTA_INTERNAL_TRACE_ID, "")
+        if not trace_id:
+            return None
+        from ballista_tpu.obs import trace as obs_trace
+
         # distributed tracing (docs/observability.md): the consumer's
         # trace context rides the ticket; the serve span joins its trace
         # (parented to the consumer's shuffle_fetch span) and ships home
         # on this executor's next poll/heartbeat
-        trace_id = settings.get(BALLISTA_INTERNAL_TRACE_ID, "")
-        span_parent = settings.get(BALLISTA_INTERNAL_SPAN_PARENT, "")
-        options = (
-            paipc.IpcWriteOptions(compression=codec)
-            if codec in _STREAM_CODECS
-            else None
+        return obs_trace.start(
+            "flight_serve",
+            trace_id,
+            settings.get(BALLISTA_INTERNAL_SPAN_PARENT, ""),
+            attrs={
+                "job_id": fp.job_id,
+                "stage_id": fp.stage_id,
+                "partition": fp.partition_id,
+                **({"push": 1} if push else {}),
+            },
         )
+
+    def do_get(self, context, ticket: paflight.Ticket):
+        action = _parse_action(ticket.ticket)
+        fp = action.fetch_partition
+        path = self._contained_path(fp.path)
+        settings = {kv.key: kv.value for kv in action.settings}
+        options = _stream_options(settings)
 
         from ballista_tpu.testing import faults
 
         inj = faults.active()
 
-        # Opened LAST — everything above can raise, and an open file has no
-        # owner until the GeneratorStream below takes it. The fd is owned
-        # EXPLICITLY (pa.OSFile): pyarrow's RecordBatchFileReader has no
-        # close() and never closes a source it was handed, so the previous
-        # open_file(path) held an internal fd per request until GC
-        # (lifelint leaked-resource — fd pressure under shuffle fan-in).
-        # Buffered (not mmap) reads: the batches are serialized out to the
-        # wire immediately, so zero-copy buys nothing here, while a mapped
-        # 256MB+ file's touched pages would sit in this process's RSS
-        # (readers take the mmap fast path on LOCAL files instead)
+        # Opened LAST — everything above can raise, and an open file has
+        # no owner until the GeneratorStream below takes it. The map is
+        # owned EXPLICITLY (pa.memory_map): pyarrow's
+        # RecordBatchFileReader has no close() and never closes a source
+        # it was handed (lifelint leaked-resource — fd pressure under
+        # shuffle fan-in). Zero-copy: uncompressed batches alias the page
+        # cache straight into the Flight serializer instead of the
+        # buffered per-request heap copy this replaced — the touched
+        # pages live only as long as the in-flight stream (the finally
+        # closes the map), so serving N requests costs the pages of the
+        # batches currently on the wire, not N whole partitions.
         from ballista_tpu.analysis import reswitness
 
-        source = pa.OSFile(path, "rb")  # lifelint: transfer=stream-generator
+        source = pa.memory_map(path)  # lifelint: transfer=stream-generator
         src_tok = reswitness.acquire("served-file", path)
         try:
             reader = paipc.open_file(source)
@@ -114,23 +157,10 @@ class BallistaFlightService(paflight.FlightServerBase):
         # Stream the file batch-at-a-time (ref flight_service.rs:203-228
         # sends batches through a channel) — read_all() here held the whole
         # shuffle partition in server memory, an OOM at SF=100 widths. The
-        # finally closes the fd DETERMINISTICALLY on exhaustion, on a
+        # finally closes the map DETERMINISTICALLY on exhaustion, on a
         # mid-stream fault, and on client cancellation (Flight closes the
         # generator) instead of leaving each request's fd to GC.
-        serve_span = None
-        if trace_id:
-            from ballista_tpu.obs import trace as obs_trace
-
-            serve_span = obs_trace.start(
-                "flight_serve",
-                trace_id,
-                span_parent,
-                attrs={
-                    "job_id": fp.job_id,
-                    "stage_id": fp.stage_id,
-                    "partition": fp.partition_id,
-                },
-            )
+        serve_span = self._serve_span(settings, fp, push=False)
 
         def batches(r=reader, src=source, tok=src_tok, span=serve_span):
             try:
@@ -178,6 +208,89 @@ class BallistaFlightService(paflight.FlightServerBase):
         except BaseException:
             gen.close()
             raise
+
+    # -- push-shuffle fast path (docs/shuffle.md) ----------------------------
+    def do_exchange(self, context, descriptor, reader, writer):
+        """Serve one push stream: memory first, spilled file second, a
+        typed gone-error third. The first message is an app-metadata tag
+        (``mem``/``file``) so the consumer can meter fall-backs."""
+        action = _parse_action(descriptor.command)
+        fp = action.fetch_partition
+        path = self._contained_path(fp.path)
+        settings = {kv.key: kv.value for kv in action.settings}
+        options = _stream_options(settings)
+
+        from ballista_tpu.executor.push import REGISTRY, stream_key
+        from ballista_tpu.testing import faults
+
+        inj = faults.active()
+        key = stream_key(
+            fp.job_id, fp.stage_id, fp.map_partition, fp.partition_id
+        )
+        serve_span = self._serve_span(settings, fp, push=True)
+        outcome = "ok"
+        try:
+            batches = REGISTRY.take_batches(key)
+            if batches is not None:
+                if serve_span is not None:
+                    serve_span.attrs["source"] = "mem"
+                self._write_stream(
+                    writer, iter(batches), batches[0].schema
+                    if batches else None,
+                    options, b"mem", inj, fp, path,
+                )
+                return
+            if os.path.exists(path):
+                # spilled under backpressure (or a disk-converted
+                # commit): the pull substrate serves it — same bytes,
+                # same order (docs/shuffle.md)
+                if serve_span is not None:
+                    serve_span.attrs["source"] = "file"
+                from ballista_tpu.executor.reader import _open_local_file
+
+                with _open_local_file(path) as r:
+                    self._write_stream(
+                        writer,
+                        (r.get_batch(i)
+                         for i in range(r.num_record_batches)),
+                        r.schema, options, b"file", inj, fp, path,
+                    )
+                return
+            outcome = "error"
+            raise paflight.FlightServerError(
+                f"{PUSH_GONE} push stream {key} has no live stream and "
+                f"no spilled file at {path!r}: the producer is gone — "
+                "recompute the map output (docs/shuffle.md)"
+            )
+        except BaseException as e:
+            outcome = "error"
+            if serve_span is not None:
+                serve_span.attrs["error"] = type(e).__name__
+            raise
+        finally:
+            if serve_span is not None:
+                from ballista_tpu.obs import trace as obs_trace
+
+                obs_trace.finish(serve_span, outcome)
+
+    @staticmethod
+    def _write_stream(writer, batches, schema, options, tag, inj, fp, path):
+        """Write one batch iterator to the exchange writer, injecting the
+        producer-kill chaos point at the same per-batch position the
+        do_get path exposes."""
+        if schema is None:
+            return
+        if options is not None:
+            writer.begin(schema, options=options)
+        else:
+            writer.begin(schema)
+        writer.write_metadata(tag)
+        for i, rb in enumerate(batches):
+            if inj is not None:
+                inj.on_serve_batch(
+                    fp.job_id, fp.stage_id, fp.partition_id, i, path=path,
+                )
+            writer.write_batch(rb)
 
     # Remaining verbs deliberately unimplemented (ref :119-184).
 

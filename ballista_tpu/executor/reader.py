@@ -85,19 +85,78 @@ def _open_local_file(path: str):
         reswitness.release(tok)
 
 
+_LOCAL_HOSTS: frozenset | None = None
+
+
+def _local_hostnames() -> frozenset:
+    """Names/addresses that mean 'this host' for the per-link codec
+    negotiation (computed once; getfqdn can stat resolvers)."""
+    global _LOCAL_HOSTS
+    if _LOCAL_HOSTS is None:
+        import socket
+
+        names = {"", "localhost", "127.0.0.1", "::1"}
+        try:
+            host = socket.gethostname()
+            names.add(host)
+            names.add(socket.getfqdn())
+            # interface ADDRESSES too: executors on one machine commonly
+            # advertise an IP, and missing it would negotiate lz4 onto a
+            # loopback link — the exact regression 'auto' exists to fix
+            for info in socket.getaddrinfo(host, None):
+                names.add(info[4][0])
+        except OSError:  # pragma: no cover — resolver-less hosts
+            pass
+        _LOCAL_HOSTS = frozenset(names)
+    return _LOCAL_HOSTS
+
+
+def resolve_link_codec(codec: str, loc: PartitionLocation) -> str:
+    """Per-(producer, consumer) codec negotiation (docs/shuffle.md):
+    ``auto`` picks ``none`` when the pair is colocated — the file is
+    reachable on this filesystem, or the producer's advertised host IS
+    this host. (ICI-colocated pairs never reach this code path at all:
+    the planner fuses them into one mesh executor whose all_to_all runs
+    over ICI inside shard_map — parallel/collective.py — so by the time
+    bytes hit the Flight data plane, 'same host' is exactly the
+    colocation the mesh left us.) Anything crossing a real NIC gets lz4:
+    BENCH_SHUFFLE's codec_wire_ratio shows ~2x fewer wire bytes for
+    single-digit-% CPU. Explicit codecs pass through unchanged."""
+    if codec != "auto":
+        return codec
+    if os.path.exists(loc.path) or loc.host in _local_hostnames():
+        return "none"
+    return "lz4"
+
+
 def fetch_partition_table(loc: PartitionLocation) -> pa.Table:
-    """One shuffle file -> Arrow table. Local files come back zero-copy off
-    a memory map (the table aliases the page cache — no heap copy of the
-    partition); remote ones are assembled from the streamed Flight batch
-    path, so nothing buffers the whole partition ON TOP of the table the
-    caller asked for. Shuffle readers should prefer
+    """One shuffle partition -> Arrow table. Local files come back
+    zero-copy off a memory map (the table aliases the page cache — no
+    heap copy of the partition); a colocated push stream materializes
+    straight from the registry's batches (no serialization at all);
+    remote ones are assembled from the streamed Flight batch path, so
+    nothing buffers the whole partition ON TOP of the table the caller
+    asked for. Shuffle readers should prefer
     :func:`fetch_partition_batches` and never materialize at all."""
+    if loc.push:
+        from ballista_tpu.executor.push import REGISTRY, stream_key
+
+        batches = REGISTRY.take_batches(
+            stream_key(loc.job_id, loc.stage_id, loc.map_partition,
+                       loc.partition)
+        )
+        if batches is not None:
+            return pa.Table.from_batches(batches)
     if os.path.exists(loc.path):
         try:
             with _open_local_file(loc.path) as r:
                 return r.read_all()
         except (pa.ArrowInvalid, pa.ArrowIOError, OSError) as e:
             raise _local_fetch_error(loc, e) from e
+    if loc.push:
+        from ballista_tpu.client.flight import fetch_push_partition
+
+        return fetch_push_partition(loc)
     from ballista_tpu.client.flight import fetch_partition
 
     return fetch_partition(loc)
@@ -127,6 +186,7 @@ def fetch_partition_batches(
     compression: str = "",
     local_fastpath: bool = True,
     trace_ctx: tuple[str, str] | None = None,
+    on_push_fallback=None,
 ) -> Iterator[pa.RecordBatch]:
     """One shuffle file -> record-batch stream; peak memory is a batch,
     not the partition (ref shuffle_reader.rs streams batches through the
@@ -140,10 +200,50 @@ def fetch_partition_batches(
 
     ``compression`` asks the SERVING executor to compress the Flight
     stream with that codec (files are self-describing, so the local path
-    ignores it). ``trace_ctx`` — the consuming task's (trace_id,
-    span_id): remote fetches carry it in the Flight ticket settings so
-    the serving executor's serve span joins the same trace
-    (docs/observability.md)."""
+    ignores it); ``auto`` negotiates per link (resolve_link_codec).
+    ``trace_ctx`` — the consuming task's (trace_id, span_id): remote
+    fetches carry it in the Flight ticket settings so the serving
+    executor's serve span joins the same trace (docs/observability.md).
+
+    Push locations (docs/shuffle.md) try, in order: the in-process push
+    registry (colocated consumer — zero copies, zero serialization), the
+    local spilled/committed file, then a remote DoExchange stream that
+    itself serves memory-or-file. ``on_push_fallback`` fires when a push
+    location ended up served from disk — the backpressure/lag signal the
+    push_fallbacks counter reads."""
+    compression = resolve_link_codec(compression, loc)
+    if loc.push:
+        if local_fastpath:
+            # the in-process registry shortcut is the push analogue of
+            # the mmap local fast path: same colocation concept, same
+            # knob (off forces every byte through the Flight wire path —
+            # the separate-hosts shape, and what bench.py paces), and
+            # the same fetch-attempt fault plumbing — fetch_error/
+            # fetch_slow rules must fire here exactly like on the file
+            # fast path, or chaos/fault tests silently stop covering
+            # push-mode runs
+            from ballista_tpu.executor.push import REGISTRY, stream_key
+
+            _inject_local_fetch_faults(loc, retries, backoff_ms)
+            batches = REGISTRY.take_batches(
+                stream_key(loc.job_id, loc.stage_id, loc.map_partition,
+                           loc.partition)
+            )
+            if batches is not None:
+                yield from _local_push_batches(loc, batches)
+                return
+        if not (local_fastpath and os.path.exists(loc.path)):
+            from ballista_tpu.client.flight import fetch_push_batches
+
+            yield from fetch_push_batches(
+                loc, retries, backoff_ms, timeout_s, compression,
+                trace_ctx=trace_ctx, on_fallback=on_push_fallback,
+            )
+            return
+        # spilled under backpressure and we share its filesystem: the
+        # pull fast path below serves the very file the stream spilled to
+        if on_push_fallback is not None:
+            on_push_fallback()
     if local_fastpath and os.path.exists(loc.path):
         from ballista_tpu.testing import faults
 
@@ -182,6 +282,37 @@ def fetch_partition_batches(
         loc, retries, backoff_ms, timeout_s, compression,
         trace_ctx=trace_ctx,
     )
+
+
+def _local_push_batches(
+    loc: PartitionLocation, batches: list
+) -> Iterator[pa.RecordBatch]:
+    """Colocated push consumption straight out of the in-process registry
+    (the memory analogue of the mmap local fast path). Exposes the SAME
+    ``producer_kill`` chaos point the file paths expose — standalone
+    clusters consume push streams in-process, so chaos tests would never
+    reach the Flight-side hook — with the push path tagged so the kill
+    harness can attribute the stream to its producing executor."""
+    from ballista_tpu.testing import faults
+
+    inj = faults.active()
+    for i, rb in enumerate(batches):
+        if inj is not None:
+            try:
+                inj.on_serve_batch(
+                    loc.job_id, loc.stage_id, loc.partition, i,
+                    path=loc.path,
+                )
+            except faults.InjectedFault as e:
+                raise ShuffleFetchError(
+                    str(e),
+                    job_id=loc.job_id,
+                    stage_id=loc.stage_id,
+                    partition=loc.partition,
+                    executor_id=loc.executor_id,
+                    transient=False,
+                ) from e
+        yield rb
 
 
 def _inject_local_fetch_faults(
@@ -663,10 +794,16 @@ class ShuffleReaderExec(ExecutionPlan):
 
         trace_parent = obs_trace.current()
 
+        def on_push_fallback():
+            # a push location got served from disk (spilled under the
+            # window, or the stream died): the lag/backpressure signal
+            self.metrics.add("push_fallbacks")
+
         def fetch_one(loc: PartitionLocation) -> Iterator[pa.RecordBatch]:
             it = fetch_partition_batches(
                 loc, retries, backoff_ms, timeout_s, compression,
                 local_fastpath, trace_ctx=trace_parent,
+                on_push_fallback=on_push_fallback,
             )
             if trace_parent is None:
                 return it

@@ -11,6 +11,20 @@ per output partition:
 With no partition keys the stage writes a single output partition (the
 coalesce boundary, ref planner.rs:62-78). Returns per-file metadata
 (path + row/batch/byte stats) that flows back in CompletedTask statuses.
+
+Data-plane perf (docs/shuffle.md):
+
+- **Batch coalescing** — post-partition slices are ``batch_bytes /
+  fan_out`` small; every appender concatenates them up to
+  ``ballista.tpu.shuffle_target_batch_mb`` before write/stream so the
+  wire and the reader pay per-batch fixed costs once per target-size
+  batch, not once per sliver.
+- **Push shuffle** (``ballista.tpu.push_shuffle``, eager jobs on a
+  scheduler-connected executor): output partitions commit into the
+  in-memory push registry (executor/push.py) instead of files — zero
+  disk I/O while consumers keep up; window overflow spills to the very
+  path the meta advertises, so consumers transparently fall back to the
+  pull plane.
 """
 
 from __future__ import annotations
@@ -24,6 +38,7 @@ import pyarrow.ipc as paipc
 
 from ballista_tpu.columnar.arrow_interop import batch_to_arrow
 from ballista_tpu.columnar.batch import DeviceBatch
+from ballista_tpu.columnar.coalesce import BatchCoalescer
 from ballista_tpu.datatypes import Schema
 from ballista_tpu.errors import ExecutionError
 from ballista_tpu.exec.base import (
@@ -36,6 +51,14 @@ from ballista_tpu.exec.repartition import jit_partition_ids
 from ballista_tpu.expr import logical as L
 from ballista_tpu.ops.partition import string_key_tables
 from ballista_tpu.scheduler_types import ShuffleWritePartitionMeta
+
+
+def resolve_file_codec(codec: str) -> str:
+    """The codec shuffle FILES are written with. ``auto`` resolves to
+    ``none``: the wire codec is negotiated per (producer, consumer) link
+    at fetch time (reader.py), so compressing the at-rest bytes would
+    only tax colocated readers' zero-copy mmap path."""
+    return "none" if codec == "auto" else codec
 
 
 class ShuffleWriterExec(ExecutionPlan):
@@ -74,6 +97,23 @@ class ShuffleWriterExec(ExecutionPlan):
             f"keys={keys}, out={self.output_partitions}"
         )
 
+    def _push_eligible(self, ctx: TaskContext) -> bool:
+        """Push shuffle is an opportunistic fast path with hard
+        prerequisites: the session opted in (default on), the job is
+        EAGER (consumers learn locations task-by-task — barriered
+        sessions bake locations at promotion and gain nothing from
+        memory residency), the executor is scheduler-connected (the same
+        requirement the eager reader has; direct/in-proc plan execution
+        keeps the pull path), and the window is positive."""
+        cfg = ctx.config
+        return bool(
+            cfg.push_shuffle()
+            and cfg.eager_shuffle()
+            and ctx.work_dir
+            and ctx.shuffle_locations is not None
+            and cfg.push_shuffle_window_mb() > 0
+        )
+
     # -- the task entry point (ref shuffle_writer.rs:142-292) ----------------
     def execute_shuffle_write(
         self, input_partition: int, ctx: TaskContext
@@ -87,59 +127,92 @@ class ShuffleWriterExec(ExecutionPlan):
             else self._key_error(k)
             for k in self.partition_keys
         )
-        writers: dict[int, _IpcAppender] = {}
-        ipc_options = _ipc_write_options(ctx.config.shuffle_compression())
+        writers: dict[int, _Appender] = {}
+        file_codec = resolve_file_codec(ctx.config.shuffle_compression())
+        ipc_options = _ipc_write_options(file_codec)
+        target_bytes = ctx.config.shuffle_target_batch_mb() << 20
+        push = self._push_eligible(ctx)
+        window_bytes = ctx.config.push_shuffle_window_mb() << 20
 
-        def appender(out_part: int) -> "_IpcAppender":
+        def appender(out_part: int) -> "_Appender":
             w = writers.get(out_part)
             if w is None:
                 d = os.path.join(
                     ctx.work_dir, self.job_id, str(self.stage_id),
                     str(out_part),
                 )
-                os.makedirs(d, exist_ok=True)
-                path = os.path.join(d, f"data-{input_partition}.arrow")
-                w = _IpcAppender(path, options=ipc_options)
+                if push:
+                    path = os.path.join(
+                        d, f"push-{input_partition}.arrow"
+                    )
+                    w = _PushAppender(
+                        path,
+                        key=(
+                            self.job_id, self.stage_id, input_partition,
+                            out_part,
+                        ),
+                        owner=ctx.work_dir,
+                        options=ipc_options,
+                        window_bytes=window_bytes,
+                        target_bytes=target_bytes,
+                        metrics=self.metrics,
+                    )
+                else:
+                    os.makedirs(d, exist_ok=True)
+                    path = os.path.join(d, f"data-{input_partition}.arrow")
+                    w = _IpcAppender(
+                        path, options=ipc_options, target_bytes=target_bytes
+                    )
                 writers[out_part] = w
             return w
 
-        with self.metrics.time("write_time"):
-            for batch in self.input.execute(input_partition, ctx):
-                if not self.partition_keys or self.output_partitions == 1:
+        try:
+            with self.metrics.time("write_time"):
+                for batch in self.input.execute(input_partition, ctx):
+                    if not self.partition_keys or self.output_partitions == 1:
+                        rb = batch_to_arrow(batch)
+                        if rb.num_rows:
+                            appender(0).write(rb)
+                        continue
+                    with self.metrics.time("repart_time"):
+                        tables = string_key_tables(batch, list(key_idxs))
+                        pids = np.asarray(
+                            jit_partition_ids(
+                                key_idxs, self.output_partitions
+                            )(batch, tables)
+                        )
                     rb = batch_to_arrow(batch)
-                    if rb.num_rows:
-                        appender(0).write(rb)
-                    continue
-                with self.metrics.time("repart_time"):
-                    tables = string_key_tables(batch, list(key_idxs))
-                    pids = np.asarray(
-                        jit_partition_ids(key_idxs, self.output_partitions)(
-                            batch, tables
-                        )
+                    live_pids = pids[np.asarray(batch.valid)]
+                    # Single sort-based scatter: ONE stable argsort + ONE
+                    # gather into bucket order, then zero-copy slices per
+                    # bucket — the per-unique-pid rb.take loop re-walked
+                    # every column's buffers once per populated bucket
+                    # (K gathers of the whole batch instead of one).
+                    order = np.argsort(live_pids, kind="stable")
+                    sorted_rb = rb.take(pa.array(order))
+                    sorted_pids = live_pids[order]
+                    bounds = np.searchsorted(
+                        sorted_pids, np.arange(self.output_partitions + 1)
                     )
-                rb = batch_to_arrow(batch)
-                live_pids = pids[np.asarray(batch.valid)]
-                # Single sort-based scatter: ONE stable argsort + ONE
-                # gather into bucket order, then zero-copy slices per
-                # bucket — the per-unique-pid rb.take loop re-walked every
-                # column's buffers once per populated bucket (K gathers of
-                # the whole batch instead of one).
-                order = np.argsort(live_pids, kind="stable")
-                sorted_rb = rb.take(pa.array(order))
-                sorted_pids = live_pids[order]
-                bounds = np.searchsorted(
-                    sorted_pids, np.arange(self.output_partitions + 1)
-                )
-                for out_part in range(self.output_partitions):
-                    lo, hi = int(bounds[out_part]), int(bounds[out_part + 1])
-                    if hi > lo:
-                        appender(out_part).write(
-                            sorted_rb.slice(lo, hi - lo)
-                        )
+                    for out_part in range(self.output_partitions):
+                        lo = int(bounds[out_part])
+                        hi = int(bounds[out_part + 1])
+                        if hi > lo:
+                            appender(out_part).write(
+                                sorted_rb.slice(lo, hi - lo)
+                            )
+        except BaseException:
+            # a failed ATTEMPT must leave nothing observable: push streams
+            # are aborted (the registry key frees for the retry); partial
+            # files keep the pre-existing contract (never published,
+            # swept by TTL)
+            for w in writers.values():
+                w.discard()
+            raise
 
         out = []
         for out_part, w in sorted(writers.items()):
-            num_rows, num_batches, num_bytes = w.close()
+            num_rows, num_batches, num_bytes, pushed = w.close()
             self.metrics.add("output_rows", num_rows)
             out.append(
                 ShuffleWritePartitionMeta(
@@ -148,6 +221,7 @@ class ShuffleWriterExec(ExecutionPlan):
                     num_batches=num_batches,
                     num_rows=num_rows,
                     num_bytes=num_bytes,
+                    push=pushed,
                 )
             )
         return out
@@ -165,10 +239,9 @@ class ShuffleWriterExec(ExecutionPlan):
 
 
 def _ipc_write_options(codec: str) -> paipc.IpcWriteOptions | None:
-    """ballista.tpu.shuffle_compression -> IpcWriteOptions. Readers
-    auto-detect per file (the codec rides the IPC message headers), so
-    writers upgraded to a new default coexist with old files inside one
-    consumed partition."""
+    """Resolved codec -> IpcWriteOptions. Readers auto-detect per file
+    (the codec rides the IPC message headers), so writers upgraded to a
+    new default coexist with old files inside one consumed partition."""
     if codec in ("", "none"):
         return None
     try:
@@ -180,19 +253,48 @@ def _ipc_write_options(codec: str) -> paipc.IpcWriteOptions | None:
         ) from e
 
 
-class _IpcAppender:
-    """One Arrow IPC file being appended batch-by-batch (the reference's
-    IPCWriter, shuffle_writer.rs:162-199). A lifetime with zero writes
-    closes clean: no file is created and the stats are (0, 0, 0)."""
+class _Appender:
+    """Shared appender surface: ``write`` record batches in order,
+    ``close`` -> (rows, batches, bytes, pushed), ``discard`` on attempt
+    failure."""
 
-    def __init__(self, path: str, options: paipc.IpcWriteOptions | None = None):
+    path: str
+
+    def write(self, rb: pa.RecordBatch) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def close(self) -> tuple[int, int, int, bool]:  # pragma: no cover
+        raise NotImplementedError
+
+    def discard(self) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class _IpcAppender(_Appender):
+    """One Arrow IPC file being appended batch-by-batch (the reference's
+    IPCWriter, shuffle_writer.rs:162-199), coalescing sub-target batches
+    before they hit the file. A lifetime with zero writes closes clean:
+    no file is created and the stats are (0, 0, 0)."""
+
+    def __init__(
+        self,
+        path: str,
+        options: paipc.IpcWriteOptions | None = None,
+        target_bytes: int = 0,
+    ):
         self.path = path
         self._options = options
         self._writer: paipc.RecordBatchFileWriter | None = None
+        self._coalescer = BatchCoalescer(target_bytes)
         self.num_rows = 0
         self.num_batches = 0
 
     def write(self, rb: pa.RecordBatch) -> None:
+        out = self._coalescer.add(rb)
+        if out is not None:
+            self._write_now(out)
+
+    def _write_now(self, rb: pa.RecordBatch) -> None:
         if self._writer is None:
             if self._options is not None:
                 self._writer = paipc.new_file(
@@ -204,8 +306,66 @@ class _IpcAppender:
         self.num_rows += rb.num_rows
         self.num_batches += 1
 
-    def close(self) -> tuple[int, int, int]:
+    def close(self) -> tuple[int, int, int, bool]:
+        tail = self._coalescer.flush()
+        if tail is not None:
+            self._write_now(tail)
         if self._writer is not None:
             self._writer.close()
         num_bytes = os.path.getsize(self.path) if os.path.exists(self.path) else 0
-        return self.num_rows, self.num_batches, num_bytes
+        return self.num_rows, self.num_batches, num_bytes, False
+
+    def discard(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+class _PushAppender(_Appender):
+    """One output partition being committed into the push registry
+    (docs/shuffle.md): coalesced batches append to an in-memory stream;
+    the registry's window eviction may convert it to disk mid-write, and
+    ``close`` seals it — push=True when it committed in memory. Spill
+    bytes forced by this task's appends land in its own
+    ``push_spill_bytes`` metric."""
+
+    def __init__(self, path, key, owner, options, window_bytes,
+                 target_bytes, metrics):
+        from ballista_tpu.executor.push import REGISTRY
+
+        self.path = path
+        self._registry = REGISTRY
+        # ownership lives in the registry from birth: seal() commits it
+        # for consumers, abort()/drop_owner retire it — never this class
+        self._stream = REGISTRY.open(  # lifelint: transfer=push-registry
+            key, path, owner, options
+        )
+        self._window_bytes = window_bytes
+        self._coalescer = BatchCoalescer(target_bytes)
+        self._metrics = metrics
+
+    def write(self, rb: pa.RecordBatch) -> None:
+        out = self._coalescer.add(rb)
+        if out is not None:
+            self._append_now(out)
+
+    def _append_now(self, rb: pa.RecordBatch) -> None:
+        spilled = self._registry.append(
+            self._stream, rb, self._window_bytes
+        )
+        if spilled:
+            self._metrics.add("push_spill_bytes", spilled)
+
+    def close(self) -> tuple[int, int, int, bool]:
+        tail = self._coalescer.flush()
+        if tail is not None:
+            self._append_now(tail)
+        num_rows, num_batches, num_bytes, pushed = self._registry.seal(
+            self._stream
+        )
+        if pushed:
+            self._metrics.add("pushed_bytes", num_bytes)
+        return num_rows, num_batches, num_bytes, pushed
+
+    def discard(self) -> None:
+        self._registry.abort(self._stream)

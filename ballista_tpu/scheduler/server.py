@@ -1126,6 +1126,12 @@ class SchedulerServer:
                         host=host,
                         port=port,
                         path=m.path,
+                        # push-capable metadata (docs/shuffle.md): the
+                        # consumer tries the producer's in-memory stream
+                        # (keyed by the producing map task) before the
+                        # file path
+                        push=m.push,
+                        map_partition=task_idx,
                     )
                 )
         return locs
@@ -1892,6 +1898,7 @@ class SchedulerServer:
                         num_batches=int(p.num_batches),
                         num_rows=int(p.num_rows),
                         num_bytes=int(p.num_bytes),
+                        push=bool(p.push),
                     )
                     for p in st.completed.partitions
                 ]
@@ -1998,6 +2005,9 @@ class SchedulerServer:
                         host=host,
                         port=port,
                         path=m.path,
+                        # push-capable eager metadata (docs/shuffle.md)
+                        push=m.push,
+                        map_partition=task_idx,
                     )
                 )
             )

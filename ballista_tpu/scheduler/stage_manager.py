@@ -864,6 +864,12 @@ class StageManager:
                             "output_batches": sum(
                                 m.num_batches for m in t.partitions
                             ),
+                            # push-shuffle visibility (docs/shuffle.md):
+                            # how many of this task's output partitions
+                            # committed in memory vs on disk
+                            "output_pushed": sum(
+                                1 for m in t.partitions if m.push
+                            ),
                             # timeline (docs/observability.md): the
                             # current attempt's wall-clock window + the
                             # straggler-monitor flag

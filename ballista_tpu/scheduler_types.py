@@ -32,7 +32,14 @@ class PartitionStats:
 
 @dataclasses.dataclass(frozen=True)
 class PartitionLocation:
-    """Where one shuffle output partition lives (ref mod.rs:118-140)."""
+    """Where one shuffle output partition lives (ref mod.rs:118-140).
+
+    ``push`` marks a push-shuffle location (docs/shuffle.md): the
+    producing executor committed the partition into its in-memory push
+    registry, keyed ``(job_id, stage_id, map_partition, partition)`` —
+    consumers stream it over Flight DoExchange (or read the in-process
+    registry when colocated) and fall back to the pull path at ``path``
+    when the stream spilled under backpressure or is gone."""
 
     job_id: str
     stage_id: int
@@ -42,6 +49,8 @@ class PartitionLocation:
     port: int
     path: str
     stats: PartitionStats = PartitionStats()
+    push: bool = False
+    map_partition: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,10 +82,14 @@ class ExecutorData:
 @dataclasses.dataclass(frozen=True)
 class ShuffleWritePartitionMeta:
     """One shuffle output file written by a task (ref CompletedTask
-    partitions, proto ShuffleWritePartition)."""
+    partitions, proto ShuffleWritePartition). ``push`` means the data was
+    committed into the producing executor's in-memory push registry
+    instead of a file — ``path`` is where it WOULD spill under
+    backpressure (the consumer's fall-back target)."""
 
     partition_id: int
     path: str
     num_batches: int
     num_rows: int
     num_bytes: int
+    push: bool = False

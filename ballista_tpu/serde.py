@@ -1152,6 +1152,8 @@ def loc_to_proto(loc) -> pb.PartitionLocation:
             id=loc.executor_id, host=loc.host, port=loc.port
         ),
         path=loc.path,
+        push=loc.push,
+        map_partition=loc.map_partition,
     )
 
 
@@ -1166,4 +1168,6 @@ def loc_from_proto(p: pb.PartitionLocation):
         host=p.executor_meta.host,
         port=p.executor_meta.port,
         path=p.path,
+        push=bool(p.push),
+        map_partition=int(p.map_partition),
     )

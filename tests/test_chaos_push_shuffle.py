@@ -1,22 +1,25 @@
-"""Chaos acceptance for EAGER shuffle (ISSUE 6, docs/shuffle.md).
+"""Chaos acceptance for PUSH shuffle (ISSUE 13, docs/shuffle.md).
 
-A two-executor cluster runs TPC-H q5 with eager shuffle ON (the default)
-while a map executor dies mid-stream: the producer_kill fault breaks one
-shuffle stream AFTER the consumer already streamed part of that
-executor's output, and the test then kills that same executor outright
-(loops stopped, Flight down, work dir DELETED). Lineage recovery must
-recompute the lost map output and the final result must be BIT-EXACT vs a
-clean fault-free run with identical settings — the guarantee that eager,
-pre-barrier consumption cannot observe a different stream than barriered
-consumption, even across recovery.
+A two-executor cluster runs TPC-H q5 with push shuffle ON (the default)
+while a producer dies mid-push-stream: the producer_kill fault breaks one
+in-memory stream AFTER the consumer already pulled part of it, and the
+test then kills that same executor outright (loops stopped — its push
+registry entries dropped — Flight down, work dir DELETED). Lineage
+recovery must recompute the lost map output and the final result must be
+BIT-EXACT vs a clean fault-free run, with the replay witness recording
+zero hash mismatches (push-committed partitions hash canonically against
+their recomputed re-records) and the resource witness draining to zero —
+no leaked push streams, spill buckets, channels, or files.
 
-Small device batches (ballista.tpu.batch_rows) make shuffle files
-multi-batch at this SF, so "mid-stream" is a real position inside a file,
-not a whole-file boundary.
+A second pass forces the consumer-lag/backpressure shape: a 1MB push
+window makes streams spill to their fall-back files mid-run
+(push_spill_bytes > 0 in the shipped task counters), and the result must
+STILL be bit-exact — the pull fall-back serves the same bytes.
 
-Runs in a subprocess (cleaned JAX-on-CPU env, like the other distributed
-tests); fault rules are installed programmatically inside it — the
-conftest guard keeps the pytest process itself injection-free.
+Coalescing is disabled in-script so streams stay multi-batch at this SF
+("mid-stream" must be a real position inside a stream, not a whole-stream
+boundary). Runs in a subprocess (cleaned JAX-on-CPU env); fault rules are
+installed programmatically inside it.
 """
 
 import pathlib
@@ -34,6 +37,7 @@ import time
 
 import pandas as pd
 
+from ballista_tpu.analysis import replay, reswitness
 from ballista_tpu.client.context import BallistaContext
 from ballista_tpu.config import BallistaConfig
 from ballista_tpu.testing import faults
@@ -46,19 +50,19 @@ data = gen_all(scale=SF)
 SETTINGS = {
     "ballista.shuffle.partitions": "2",
     "ballista.tpu.fetch_backoff_ms": "10",
-    # small device batches + coalescing OFF -> multi-batch shuffle
-    # files/streams, so producer_kill can break a stream genuinely
-    # mid-file (the PR 13 default coalesces slivers into one batch)
+    # small device batches + no coalescing -> multi-batch push streams,
+    # so producer_kill can break a stream genuinely mid-way
     "ballista.tpu.batch_rows": "4096",
     "ballista.tpu.shuffle_target_batch_mb": "0",
-    # eager is the default; pin it anyway — this test is ABOUT eager mode
+    # push is the default; pin everything this test is ABOUT
     "ballista.tpu.eager_shuffle": "true",
+    "ballista.tpu.push_shuffle": "true",
 }
 
 
-def make_ctx():
+def make_ctx(extra=None):
     cfg = BallistaConfig()
-    for k, v in SETTINGS.items():
+    for k, v in {**SETTINGS, **(extra or {})}.items():
         cfg = cfg.with_setting(k, v)
     ctx = BallistaContext.standalone(
         cfg,
@@ -76,18 +80,24 @@ def run_q5(ctx):
     return ctx.sql(sql).collect().to_pandas()
 
 
+assert replay.enabled(), "subprocess must run with BALLISTA_REPLAY_WITNESS=1"
+
 # ---- clean pass (no faults) ------------------------------------------------
 assert not faults.enabled()
 clean_ctx = make_ctx()
 clean = run_q5(clean_ctx)
+pushed0 = clean_ctx._standalone_cluster.scheduler.obs_task_counters.get(
+    "pushed_bytes", 0
+)
+assert pushed0 > 0, (
+    "clean run shipped no pushed_bytes: the push plane never engaged "
+    f"(counters={clean_ctx._standalone_cluster.scheduler.obs_task_counters})"
+)
 clean_ctx.close()
 assert len(clean) > 0, f"q5 empty at SF={SF}: comparison trivial"
-print("CLEAN-OK", len(clean))
+print("CLEAN-OK", len(clean), "pushed_bytes", pushed0)
 
-# ---- chaos pass ------------------------------------------------------------
-# ONE stream of ONE map output breaks after >= 1 batch already flowed to a
-# consumer; a slow-fetch rule stretches the shuffle phase so the follow-up
-# executor kill lands mid-query deterministically enough to assert on
+# ---- chaos pass: producer killed mid-push-stream ---------------------------
 faults.install(
     [
         {"point": "producer_kill", "after_batches": 1, "max_fires": 1},
@@ -114,7 +124,8 @@ t = threading.Thread(target=drive)
 t.start()
 
 # wait for the injected mid-stream break, then identify the executor whose
-# file was being served (the path rides in the injection log) and kill it
+# push stream was being consumed (the path rides the injection log) and
+# kill it — streams die with their producer
 inj = faults.active()
 victim_path = None
 deadline = time.time() + 120
@@ -125,6 +136,9 @@ while time.time() < deadline and victim_path is None:
             break
     time.sleep(0.005)
 assert victim_path is not None, "producer_kill never fired"
+assert "push-" in victim_path.rsplit("/", 1)[-1], (
+    f"expected the break inside a PUSH stream, got {victim_path}"
+)
 victim_idx = next(
     i for i, h in enumerate(cluster.executors)
     if victim_path.startswith(h.work_dir)
@@ -153,25 +167,46 @@ print("RECOVERY-COUNTERS", [
     (j.job_id, j.total_retries, j.total_recomputes) for j in jobs
 ])
 
-# ---- bit-exactness vs the clean run ----------------------------------------
 got = result["df"]
-assert list(got.columns) == list(clean.columns)
 wk = clean.sort_values(list(clean.columns)).reset_index(drop=True)
 gk = got.sort_values(list(got.columns)).reset_index(drop=True)
 pd.testing.assert_frame_equal(gk, wk, check_exact=True)
 chaos_ctx.close()
 faults.install(None)
-print("EAGER-BIT-EXACT-OK")
-print("CHAOS-EAGER-OK")
+print("PUSH-BIT-EXACT-OK")
+
+# ---- backpressure pass: 1MB window forces mid-run spill --------------------
+spill_ctx = make_ctx({"ballista.tpu.push_shuffle_window_mb": "1"})
+spilled_df = run_q5(spill_ctx)
+counters = spill_ctx._standalone_cluster.scheduler.obs_task_counters
+assert counters.get("push_spill_bytes", 0) > 0, (
+    f"1MB window forced no spill (counters={counters})"
+)
+sk = spilled_df.sort_values(list(spilled_df.columns)).reset_index(drop=True)
+pd.testing.assert_frame_equal(sk, wk, check_exact=True)
+spill_ctx.close()
+print("SPILL-FALLBACK-BIT-EXACT-OK", int(counters["push_spill_bytes"]))
+
+# ---- witnesses -------------------------------------------------------------
+# replay: every re-record across the kill/recompute/spill passes hashed
+# identically (push-vs-file residency is hash-invariant by construction)
+replay.assert_clean()
+print("REPLAY-CLEAN", replay.summary())
+# resources: zero leaked push streams, spill buckets, channels, files
+reswitness.assert_drained()
+print("RESWITNESS-DRAINED")
+print("CHAOS-PUSH-OK")
 """
 
 
 @pytest.mark.chaos
-@pytest.mark.slow  # 2 clusters + SF=0.02 q5 runs + expiry waits — over the
-# tier-1 per-test bar; the eager reader's fast semantics stay tier-1-covered
-# by tests/test_shuffle_pipeline.py
-def test_chaos_eager_producer_kill_mid_stream_bit_exact():
+@pytest.mark.slow  # 4 cluster boots + SF=0.02 q5 runs + expiry waits — over
+# the tier-1 per-test bar; the push plane's fast semantics stay tier-1-covered
+# by tests/test_push_shuffle.py
+def test_chaos_push_producer_kill_and_spill_window_bit_exact():
     env = {k: v for k, v in CPU_MESH_ENV.items() if k != "XLA_FLAGS"}
+    env["BALLISTA_REPLAY_WITNESS"] = "1"
+    env["BALLISTA_RESOURCE_WITNESS"] = "1"
     proc = subprocess.run(
         [sys.executable, "-c", SCRIPT],
         env=env,
@@ -184,8 +219,9 @@ def test_chaos_eager_producer_kill_mid_stream_bit_exact():
         f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-4000:]}"
     )
     for marker in (
-        "CLEAN-OK", "KILLED", "RECOVERY-COUNTERS",
-        "EAGER-BIT-EXACT-OK", "CHAOS-EAGER-OK",
+        "CLEAN-OK", "KILLED", "RECOVERY-COUNTERS", "PUSH-BIT-EXACT-OK",
+        "SPILL-FALLBACK-BIT-EXACT-OK", "REPLAY-CLEAN",
+        "RESWITNESS-DRAINED", "CHAOS-PUSH-OK",
     ):
         assert marker in proc.stdout, (
             f"missing {marker}\nstdout:\n{proc.stdout}\n"

@@ -1,0 +1,496 @@
+"""Push-shuffle data plane units (ISSUE 13, docs/shuffle.md).
+
+Covers the registry's state machine (commit, idempotent consumption,
+window eviction with atomic spill files, disk conversion, abort/drop),
+the DoExchange Flight path (memory serve, transparent file fall-back
+with its metering tag, the typed gone-error), batch coalescing on both
+ends, per-link codec negotiation, and the push fields' serde round-trip.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.ipc as paipc
+import pytest
+
+from ballista_tpu.columnar.coalesce import (
+    BatchCoalescer,
+    coalesce_batches,
+    concat_batches,
+)
+from ballista_tpu.errors import ShuffleFetchError
+from ballista_tpu.executor.push import PushRegistry, stream_key
+from ballista_tpu.scheduler_types import PartitionLocation
+
+
+def rb_of(n: int, base: int = 0) -> pa.RecordBatch:
+    return pa.record_batch(
+        [pa.array(np.arange(base, base + n, dtype=np.int64)),
+         pa.array(np.arange(n, dtype=np.float64))],
+        names=["k", "v"],
+    )
+
+
+def open_stream(reg, tmp_path, key=None, owner="own"):
+    key = key or stream_key("j", 2, 0, 0)
+    path = str(
+        tmp_path / "j" / str(key[1]) / str(key[3]) / f"push-{key[2]}.arrow"
+    )
+    return reg.open(key, path, owner, None)
+
+
+# ---------------------------------------------------------------------------
+# coalescing
+# ---------------------------------------------------------------------------
+
+
+def test_coalescer_preserves_rows_and_order():
+    batches = [rb_of(100, i * 100) for i in range(10)]
+    target = batches[0].nbytes * 3
+    out = list(coalesce_batches(iter(batches), target))
+    assert len(out) < len(batches)
+    merged = pa.Table.from_batches(out)
+    expect = pa.Table.from_batches(batches)
+    assert merged.equals(expect)  # same rows, same order
+    # every batch except possibly the last reached the target
+    for rb in out[:-1]:
+        assert rb.nbytes >= target
+
+
+def test_coalescer_zero_target_passthrough_and_drops_empty():
+    c = BatchCoalescer(0)
+    assert c.add(rb_of(0)) is None  # zero-row dropped
+    rb = rb_of(5)
+    assert c.add(rb) is rb  # passthrough, no copy
+    assert c.flush() is None
+
+
+def test_coalescer_oversize_batch_flushes_with_pending_prefix():
+    c = BatchCoalescer(1 << 20)
+    small = rb_of(10)
+    assert c.add(small) is None
+    big = rb_of(1 << 17)  # 16B/row -> ~2MB >= target
+    out = c.add(big)
+    assert out is not None and out.num_rows == 10 + (1 << 17)
+    # prefix order preserved: the small batch's rows come first
+    assert out.column(0)[0].as_py() == 0 and out.column(0)[9].as_py() == 9
+
+
+def test_concat_batches_unifies_dictionaries():
+    d1 = pa.record_batch(
+        [pa.array(["a", "b"]).dictionary_encode()], names=["s"]
+    )
+    d2 = pa.record_batch(
+        [pa.array(["c", "a"]).dictionary_encode()], names=["s"]
+    )
+    out = concat_batches([d1, d2])
+    assert out.num_rows == 4
+    assert out.column(0).to_pylist() == ["a", "b", "c", "a"]
+
+
+# ---------------------------------------------------------------------------
+# registry state machine
+# ---------------------------------------------------------------------------
+
+
+def test_commit_take_is_idempotent(tmp_path):
+    reg = PushRegistry()
+    s = open_stream(reg, tmp_path)
+    rb = rb_of(100)
+    assert reg.append(s, rb, 1 << 30) == 0
+    rows, nb, size, pushed = reg.seal(s)
+    assert (rows, nb, pushed) == (100, 1, True) and size == rb.nbytes
+    assert not os.path.exists(s.path)  # never touched disk
+    got1 = reg.take_batches(s.key)
+    got2 = reg.take_batches(s.key)  # capacity-retry re-fetch
+    assert got1 is got2 and len(got1) == 1
+    assert got1[0].equals(rb)
+    reg.drop_owner("own")
+    assert reg.stream_count() == 0 and reg.mem_bytes() == 0
+
+
+def test_window_overflow_spills_sealed_victim_atomically(tmp_path):
+    reg = PushRegistry()
+    window = 1 << 20
+    a = open_stream(reg, tmp_path, stream_key("j", 2, 0, 0))
+    rb = rb_of(1 << 15)  # ~512KB
+    reg.append(a, rb, window)
+    assert reg.seal(a)[3] is True  # committed in memory
+    # a second producer overflows the window: the sealed lagging stream
+    # spills to ITS advertised path and leaves memory
+    b = open_stream(reg, tmp_path, stream_key("j", 2, 1, 0))
+    spilled = reg.append(b, rb, window) + reg.append(b, rb, window)
+    assert spilled > 0
+    assert reg.take_batches(a.key) is None  # fall back to the file
+    assert os.path.exists(a.path)
+    assert not os.path.exists(a.path + ".spill.tmp")  # atomic appearance
+    with paipc.open_file(a.path) as r:
+        assert r.read_all().to_pydict() == pa.Table.from_batches(
+            [rb]
+        ).to_pydict()
+    assert reg.mem_bytes() <= window
+    reg.drop_owner("own")
+
+
+def test_window_overflow_drops_consumed_victims_without_disk(tmp_path):
+    """Eviction cost order: a CONSUMED sealed stream is dropped (no
+    fall-back file — its consumer already streamed it; a rare re-fetch
+    recovers via lineage recompute), while an UNCONSUMED one spills."""
+    reg = PushRegistry()
+    window = 1 << 20
+    rb = rb_of(1 << 15)  # ~512KB
+    consumed = open_stream(reg, tmp_path, stream_key("j", 2, 0, 0))
+    reg.append(consumed, rb, window)
+    reg.seal(consumed)
+    assert reg.take_batches(consumed.key) is not None  # consumer done
+    lagging = open_stream(reg, tmp_path, stream_key("j", 2, 1, 0))
+    reg.append(lagging, rb, window)
+    reg.seal(lagging)
+    # overflow: the consumed stream must go FIRST, and without disk I/O
+    writer = open_stream(reg, tmp_path, stream_key("j", 2, 2, 0))
+    spilled = reg.append(writer, rb, window)
+    assert spilled == 0  # dropping the consumed stream was enough
+    assert not os.path.exists(consumed.path)
+    assert reg.take_batches(consumed.key) is None  # gone -> recompute path
+    # peek: the probe must not mark the lagging stream consumed
+    assert reg.peek_batches(lagging.key) is not None  # untouched
+    # a second overflow now has only the unconsumed victim: it spills
+    spilled = reg.append(writer, rb, window)
+    assert spilled > 0 and os.path.exists(lagging.path)
+    reg.drop_owner("own")
+
+
+def test_self_conversion_commits_plain_file(tmp_path):
+    """A single stream larger than the whole window converts to disk
+    mid-write and commits as a NON-push meta: consumers read an ordinary
+    file (bit-identical rows, no push entry left behind)."""
+    reg = PushRegistry()
+    s = open_stream(reg, tmp_path)
+    rb = rb_of(1 << 14)
+    window = rb.nbytes * 2
+    batches = []
+    for i in range(5):
+        batches.append(rb_of(1 << 14, i))
+        reg.append(s, batches[-1], window)
+    rows, nb, size, pushed = reg.seal(s)
+    assert pushed is False and rows == 5 * (1 << 14)
+    assert os.path.exists(s.path) and size == os.path.getsize(s.path)
+    assert reg.stream_count() == 0 and reg.mem_bytes() == 0
+    with paipc.open_file(s.path) as r:
+        got = r.read_all()
+    assert got.equals(pa.Table.from_batches(batches))
+
+
+def test_abort_discards_partial_attempt(tmp_path):
+    reg = PushRegistry()
+    s = open_stream(reg, tmp_path)
+    reg.append(s, rb_of(10), 1 << 30)
+    reg.abort(s)
+    assert reg.stream_count() == 0 and reg.mem_bytes() == 0
+    assert reg.take_batches(s.key) is None
+    assert not os.path.exists(s.path)
+    # the retry re-opens the same key cleanly
+    s2 = open_stream(reg, tmp_path)
+    reg.append(s2, rb_of(20), 1 << 30)
+    assert reg.seal(s2)[0] == 20
+    reg.drop_owner("own")
+
+
+def test_open_replaces_previous_attempt(tmp_path):
+    reg = PushRegistry()
+    s1 = open_stream(reg, tmp_path)
+    reg.append(s1, rb_of(10), 1 << 30)
+    reg.seal(s1)
+    s2 = open_stream(reg, tmp_path)  # retry/recompute re-opens the key
+    reg.append(s2, rb_of(30), 1 << 30)
+    reg.seal(s2)
+    assert len(reg.take_batches(s2.key)) == 1
+    assert reg.take_batches(s2.key)[0].num_rows == 30
+    reg.drop_owner("own")
+    assert reg.mem_bytes() == 0
+
+
+def test_superseded_attempt_cannot_inflate_the_window(tmp_path):
+    """A superseded (hung) attempt's late appends/seal must be inert:
+    open() retires the old stream fully, so its thread resuming cannot
+    grow _mem_bytes for a stream no eviction can ever reclaim (that
+    leak permanently shrank the effective window)."""
+    reg = PushRegistry()
+    s1 = open_stream(reg, tmp_path)
+    reg.append(s1, rb_of(10), 1 << 30)
+    s2 = open_stream(reg, tmp_path)  # retry supersedes mid-production
+    before = reg.mem_bytes()
+    reg.append(s1, rb_of(1 << 15), 1 << 30)  # hung thread resumes
+    rows, nb, size, pushed = reg.seal(s1)
+    assert reg.mem_bytes() == before  # no phantom accounting
+    assert (size, pushed) == (0, False)  # nothing committed/servable
+    reg.append(s2, rb_of(30), 1 << 30)
+    reg.seal(s2)
+    assert reg.take_batches(s2.key)[0].num_rows == 30
+    reg.drop_owner("own")
+    assert reg.mem_bytes() == 0 and reg.stream_count() == 0
+
+
+def test_sweep_drops_only_stale_sealed_streams(tmp_path):
+    reg = PushRegistry()
+    s = open_stream(reg, tmp_path, stream_key("j", 2, 0, 0))
+    reg.append(s, rb_of(10), 1 << 30)
+    reg.seal(s)
+    live = open_stream(reg, tmp_path, stream_key("j", 2, 1, 0))
+    reg.append(live, rb_of(10), 1 << 30)  # open: a live task owns it
+    assert reg.sweep(3600) == 0
+    assert reg.sweep(-1) == 1  # everything sealed is "stale" at ttl<0
+    assert reg.take_batches(s.key) is None
+    assert reg.stream_count() == 1  # the open stream survived
+    reg.drop_owner("own")
+
+
+# ---------------------------------------------------------------------------
+# DoExchange Flight path
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def flight_exec(tmp_path):
+    from ballista_tpu.executor.flight_service import start_flight_server
+
+    work = tmp_path / "exec-0"
+    work.mkdir()
+    svc, port, _t = start_flight_server("127.0.0.1", 0, str(work))
+    yield str(work), port
+    svc.shutdown()
+
+
+def push_loc(work, port, key, push=True):
+    return PartitionLocation(
+        job_id=key[0], stage_id=key[1], partition=key[3],
+        executor_id="e0", host="127.0.0.1", port=port,
+        path=os.path.join(
+            work, key[0], str(key[1]), str(key[3]), f"push-{key[2]}.arrow"
+        ),
+        push=push, map_partition=key[2],
+    )
+
+
+def test_do_exchange_serves_memory_stream(flight_exec):
+    from ballista_tpu.client.flight import fetch_push_batches
+    from ballista_tpu.executor.push import REGISTRY
+
+    work, port = flight_exec
+    key = stream_key("jx", 2, 0, 0)
+    loc = push_loc(work, port, key)
+    s = REGISTRY.open(key, loc.path, work, None)
+    batches = [rb_of(64, 0), rb_of(64, 64)]
+    for rb in batches:
+        REGISTRY.append(s, rb, 1 << 30)
+    REGISTRY.seal(s)
+    try:
+        fallbacks = []
+        got = list(
+            fetch_push_batches(loc, on_fallback=lambda: fallbacks.append(1))
+        )
+        assert pa.Table.from_batches(got).equals(
+            pa.Table.from_batches(batches)
+        )
+        assert not fallbacks  # served from memory
+        assert not os.path.exists(loc.path)  # disk untouched
+    finally:
+        REGISTRY.drop_owner(work)
+
+
+def test_do_exchange_falls_back_to_spilled_file(flight_exec):
+    from ballista_tpu.client.flight import fetch_push_batches
+
+    work, port = flight_exec
+    key = stream_key("jy", 2, 0, 0)
+    loc = push_loc(work, port, key)
+    # no live stream; the spilled file sits at the advertised path
+    os.makedirs(os.path.dirname(loc.path))
+    rb = rb_of(128)
+    with paipc.new_file(loc.path, rb.schema) as w:
+        w.write_batch(rb)
+    fallbacks = []
+    got = list(
+        fetch_push_batches(loc, on_fallback=lambda: fallbacks.append(1))
+    )
+    assert fallbacks == [1]  # metered: push degraded to the pull plane
+    assert got[0].equals(rb)
+
+
+def test_do_exchange_gone_stream_is_nontransient_fetch_error(flight_exec):
+    from ballista_tpu.client.flight import fetch_push_batches
+
+    work, port = flight_exec
+    loc = push_loc(work, port, stream_key("jz", 2, 0, 0))
+    with pytest.raises(ShuffleFetchError) as ei:
+        list(fetch_push_batches(loc, retries=2, backoff_ms=1))
+    # non-transient (no redial loop) and it names the producer for the
+    # scheduler's lineage recompute
+    assert ei.value.transient is False
+    assert "[push-stream-gone]" in str(ei.value)
+    assert ei.value.executor_id == "e0" and ei.value.stage_id == 2
+
+
+def test_do_exchange_containment_rejects_escaping_path(flight_exec):
+    from ballista_tpu.client.flight import fetch_push_batches
+    import dataclasses
+
+    work, port = flight_exec
+    loc = dataclasses.replace(
+        push_loc(work, port, stream_key("jq", 2, 0, 0)),
+        path="/etc/passwd",
+    )
+    with pytest.raises(ShuffleFetchError) as ei:
+        list(fetch_push_batches(loc, retries=1))
+    assert "escapes the executor shuffle root" in str(ei.value)
+
+
+def test_reader_fetch_uses_local_registry_then_file(tmp_path):
+    """fetch_partition_batches on a push location: in-process registry
+    hit first (zero-copy), spilled file second (metered fall-back)."""
+    from ballista_tpu.executor.push import REGISTRY
+    from ballista_tpu.executor.reader import fetch_partition_batches
+
+    key = stream_key("jr", 3, 1, 0)
+    loc = push_loc(str(tmp_path), 0, key)
+    s = REGISTRY.open(key, loc.path, str(tmp_path), None)
+    rb = rb_of(32)
+    REGISTRY.append(s, rb, 1 << 30)
+    REGISTRY.seal(s)
+    try:
+        hits = []
+        got = list(
+            fetch_partition_batches(loc, on_push_fallback=hits.append)
+        )
+        assert got[0].equals(rb) and not hits
+    finally:
+        REGISTRY.drop_owner(str(tmp_path))
+    # stream gone, file present -> local fast path + fall-back meter
+    os.makedirs(os.path.dirname(loc.path), exist_ok=True)
+    with paipc.new_file(loc.path, rb.schema) as w:
+        w.write_batch(rb)
+    hits = []
+    got = list(
+        fetch_partition_batches(loc, on_push_fallback=lambda: hits.append(1))
+    )
+    assert got[0].equals(rb) and hits == [1]
+
+
+# ---------------------------------------------------------------------------
+# per-link codec negotiation + serde
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_link_codec_auto(tmp_path):
+    from ballista_tpu.executor.reader import resolve_link_codec
+
+    local_file = tmp_path / "d.arrow"
+    local_file.write_bytes(b"x")
+
+    def loc(host, path):
+        return PartitionLocation("j", 1, 0, "e", host, 1, str(path))
+
+    # colocated: shared filesystem or the producer's host is this host
+    assert resolve_link_codec("auto", loc("far.example", local_file)) == "none"
+    assert resolve_link_codec("auto", loc("localhost", "/gone")) == "none"
+    assert resolve_link_codec("auto", loc("127.0.0.1", "/gone")) == "none"
+    # a real NIC in between: cheap codec wins the wire
+    assert resolve_link_codec("auto", loc("far.example", "/gone")) == "lz4"
+    # explicit codecs pass through
+    assert resolve_link_codec("zstd", loc("localhost", "/gone")) == "zstd"
+    assert resolve_link_codec("none", loc("far.example", "/gone")) == "none"
+
+
+def test_file_codec_resolution():
+    from ballista_tpu.executor.shuffle import resolve_file_codec
+
+    assert resolve_file_codec("auto") == "none"
+    assert resolve_file_codec("lz4") == "lz4"
+    assert resolve_file_codec("none") == "none"
+
+
+def test_partition_location_push_fields_roundtrip():
+    from ballista_tpu.serde import loc_from_proto, loc_to_proto
+
+    loc = PartitionLocation(
+        "j", 4, 7, "e9", "h", 1234, "/w/p.arrow", push=True, map_partition=3
+    )
+    back = loc_from_proto(loc_to_proto(loc))
+    assert back.push is True and back.map_partition == 3
+    assert (back.job_id, back.stage_id, back.partition) == ("j", 4, 7)
+    # byte-stable re-encode (the serde-closure discipline)
+    p1 = loc_to_proto(loc).SerializeToString()
+    p2 = loc_to_proto(loc_from_proto(loc_to_proto(loc))).SerializeToString()
+    assert p1 == p2
+
+
+def test_shuffle_write_meta_push_rides_task_status():
+    from ballista_tpu.executor.executor import as_task_status
+    from ballista_tpu.proto import pb
+    from ballista_tpu.scheduler_types import ShuffleWritePartitionMeta
+
+    metas = [
+        ShuffleWritePartitionMeta(0, "/w/push-0.arrow", 1, 10, 100, push=True),
+        ShuffleWritePartitionMeta(1, "/w/data-0.arrow", 1, 10, 100),
+    ]
+    st = as_task_status(
+        pb.PartitionId(job_id="j", stage_id=2, partition_id=0), "e0",
+        metas, None,
+    )
+    got = [bool(p.push) for p in st.completed.partitions]
+    assert got == [True, False]
+
+
+def test_writer_push_commit_and_pull_fallback_file(tmp_path):
+    """ShuffleWriterExec in push mode: metas say push=True, nothing on
+    disk, and the registry holds exactly the rows a pull-mode run
+    writes to files — the two data planes carry identical content."""
+    from ballista_tpu.columnar.arrow_interop import schema_from_arrow
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.exec.base import TaskContext
+    from ballista_tpu.exec.scan import MemoryScanExec
+    from ballista_tpu.executor.push import REGISTRY
+    from ballista_tpu.executor.shuffle import ShuffleWriterExec
+    from ballista_tpu.expr import logical as L
+
+    t = pa.table(
+        {"k": np.arange(64, dtype=np.int64) % 8,
+         "v": np.arange(64, dtype=np.float64)}
+    )
+    cfg = BallistaConfig()
+
+    def make_writer():
+        scan = MemoryScanExec(t, schema_from_arrow(t.schema), partitions=1)
+        return ShuffleWriterExec("jw", 1, scan, [L.col("k")], 4)
+
+    # pull-mode reference run (no shuffle_locations -> push ineligible)
+    pull_dir = tmp_path / "pull"
+    pull_metas = make_writer().execute_shuffle_write(
+        0, TaskContext(config=cfg, work_dir=str(pull_dir))
+    )
+    assert all(not m.push for m in pull_metas)
+
+    # push-mode run: scheduler-connected executor shape
+    push_dir = tmp_path / "push"
+    ctx = TaskContext(
+        config=cfg, work_dir=str(push_dir),
+        shuffle_locations=lambda *a: None,
+    )
+    push_metas = make_writer().execute_shuffle_write(0, ctx)
+    assert push_metas and all(m.push for m in push_metas)
+    try:
+        assert not any(os.path.exists(m.path) for m in push_metas)
+        for pm, fm in zip(push_metas, pull_metas):
+            batches = REGISTRY.take_batches(
+                stream_key("jw", 1, 0, pm.partition_id)
+            )
+            got = pa.Table.from_batches(batches)
+            with paipc.open_file(fm.path) as r:
+                expect = r.read_all()
+            assert got.to_pydict() == expect.to_pydict()
+            assert pm.num_rows == fm.num_rows
+    finally:
+        REGISTRY.drop_owner(str(push_dir))

@@ -196,11 +196,11 @@ def test_ipc_appender_zero_writes(tmp_path):
     stats and NO file on disk (empty buckets publish no location)."""
     path = str(tmp_path / "data-9.arrow")
     app = _IpcAppender(path)
-    assert app.close() == (0, 0, 0)
+    assert app.close() == (0, 0, 0, False)
     assert not os.path.exists(path)
     # with compression options too
     app2 = _IpcAppender(path, options=paipc.IpcWriteOptions(compression="lz4"))
-    assert app2.close() == (0, 0, 0)
+    assert app2.close() == (0, 0, 0, False)
     assert not os.path.exists(path)
 
 

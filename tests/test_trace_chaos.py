@@ -42,10 +42,12 @@ cfg = BallistaConfig()
 for k, v in {
     "ballista.shuffle.partitions": "2",
     "ballista.tpu.fetch_backoff_ms": "10",
-    # small device batches -> multi-batch shuffle files, so producer_kill
-    # breaks a stream genuinely mid-file (the kill window is then a real
-    # in-flight position, not a race against sub-second warm queries)
+    # small device batches + coalescing OFF -> multi-batch shuffle
+    # files/streams, so producer_kill breaks a stream genuinely mid-file
+    # (the kill window is then a real in-flight position, not a race
+    # against sub-second warm queries)
     "ballista.tpu.batch_rows": "4096",
+    "ballista.tpu.shuffle_target_batch_mb": "0",
     "ballista.tpu.trace": "on",
 }.items():
     cfg = cfg.with_setting(k, v)
