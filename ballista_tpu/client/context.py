@@ -44,7 +44,14 @@ class BallistaContext(TpuContext):
         from ballista_tpu.analysis import reswitness
 
         self.scheduler_addr = scheduler_addr
-        self._channel = grpc.insecure_channel(scheduler_addr)
+        # raised receive cap: GetHistory ships the retained query log as
+        # one JSON payload, and a full task_attempts fetch on a busy
+        # cluster can exceed grpc's default 4MB receive limit (the
+        # retention bound keeps it well under this cap)
+        self._channel = grpc.insecure_channel(
+            scheduler_addr,
+            options=[("grpc.max_receive_message_length", 64 << 20)],
+        )
         self._channel_token = reswitness.acquire(
             "grpc-channel", f"client->{scheduler_addr}"
         )
@@ -116,6 +123,21 @@ class BallistaContext(TpuContext):
     def _frame(self, logical: LogicalPlan) -> DataFrame:
         return RemoteDataFrame(self, logical)
 
+    # -- system tables (docs/observability.md) -------------------------------
+    def _system_table_rows(self, name: str) -> list[dict]:
+        """Cluster contexts materialize system.* from the SCHEDULER's
+        persistent history (GetHistory RPC) — the durable, fleet-wide
+        log — instead of the local process's query log."""
+        import json
+
+        from ballista_tpu.obs.history import SYSTEM_TABLE_KINDS
+
+        res = self._stub.GetHistory(
+            pb.GetHistoryParams(kind=SYSTEM_TABLE_KINDS[name])
+        )
+        return json.loads(res.payload or b"[]")
+
+
     # -- query execution ------------------------------------------------------
     def sql(self, sql: str) -> DataFrame:
         stmt = parse_sql(sql)
@@ -132,6 +154,17 @@ class BallistaContext(TpuContext):
     ) -> pa.Table:
         """Submit a logical plan, poll to completion, fetch partitions
         (the DistributedQueryExec flow)."""
+        # system-table queries run CLIENT-side (docs/observability.md):
+        # the history lives on the scheduler, not on executors, so the
+        # scan materializes it here (GetHistory) and the query executes
+        # through the local TpuContext path — still planned, planlint-
+        # verified, and executed like any other table; only the
+        # placement differs. Mixed queries (system joined with user
+        # tables) take the local path too: the client holds both.
+        from ballista_tpu.exec.context import _scans_system_table
+
+        if _scans_system_table(logical):
+            return DataFrame(self, logical).collect()
         if self.config.verify_plans():
             # client-side gate: a plan that cannot execute fails HERE with
             # an operator path (and SQL span when known) instead of as an

@@ -94,6 +94,13 @@ BALLISTA_SKEW_MIN_ROWS = (
 BALLISTA_SCALER_QUEUE_WAIT_TARGET_S = (
     "ballista.tpu.scaler_queue_wait_target_s"  # KEDA pressure target
 )
+# queryable history + cost accounting (docs/observability.md)
+BALLISTA_COST_ACCOUNTING = (
+    "ballista.tpu.cost_accounting"  # per-attempt resource cost vectors
+)
+BALLISTA_HISTORY_RETENTION_JOBS = (
+    "ballista.tpu.history_retention_jobs"  # persistent query-log bound
+)
 
 METRICS_COLLECTORS = ("shipping", "logging")
 
@@ -751,6 +758,36 @@ def _entries() -> dict[str, ConfigEntry]:
             float,
         ),
         ConfigEntry(
+            BALLISTA_COST_ACCOUNTING,
+            "Per-attempt resource cost accounting "
+            "(docs/observability.md): executors measure a cost vector "
+            "(wall seconds, CPU thread-time, shuffle bytes read/"
+            "written, pushed bytes, spill bytes, claimed compile "
+            "seconds) around every task attempt — failed attempts too — "
+            "and ship it home on the task status. The scheduler "
+            "aggregates per job (JobInfo.cost), rolls up per query "
+            "class (the ballista_job_cost_total Prometheus counters), "
+            "and persists it with the job's history record — the "
+            "attribution substrate multi-tenant charging and fair-share "
+            "need. Off skips the measurement and ships no cost.",
+            "true",
+            _parse_bool,
+        ),
+        ConfigEntry(
+            BALLISTA_HISTORY_RETENTION_JOBS,
+            "Jobs retained in the persistent query-history log "
+            "(docs/observability.md): the append-only submit/complete/"
+            "fail records (plus per-attempt cost records) written "
+            "through the scheduler's state backend and served by "
+            "GET /api/history and the system.queries / "
+            "system.task_attempts SQL tables. Beyond this many jobs the "
+            "OLDEST jobs' records are deleted on the next submission — "
+            "compaction keeps the store bounded on every backend "
+            "(memory, sqlite, etcd).",
+            "512",
+            int,
+        ),
+        ConfigEntry(
             BALLISTA_EAGER_WAIT_S,
             "Deadline (seconds) an eager reader waits for a "
             "not-yet-published upstream location before failing the task "
@@ -940,6 +977,12 @@ class BallistaConfig:
 
     def scaler_queue_wait_target_s(self) -> float:
         return self._get(BALLISTA_SCALER_QUEUE_WAIT_TARGET_S)
+
+    def cost_accounting(self) -> bool:
+        return self._get(BALLISTA_COST_ACCOUNTING)
+
+    def history_retention_jobs(self) -> int:
+        return max(1, self._get(BALLISTA_HISTORY_RETENTION_JOBS))
 
     def __eq__(self, other) -> bool:
         return (

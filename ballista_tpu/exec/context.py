@@ -9,6 +9,7 @@ use to run stage plans locally.
 
 from __future__ import annotations
 
+import logging
 import pathlib
 
 import pyarrow as pa
@@ -42,6 +43,8 @@ from ballista_tpu.sql.parser import parse_sql
 from ballista_tpu.sql.planner import Catalog, SqlPlanner
 from ballista_tpu.tpch import all_schemas  # noqa: F401  (re-export convenience)
 
+log = logging.getLogger(__name__)
+
 
 # Serializes EXPLAIN ANALYZE runs: the verb flips the process-wide
 # BALLISTA_TPU_NO_FUSE env flag for its execution window (see
@@ -57,6 +60,21 @@ class _Registered:
         self.kind = kind  # memory | csv | parquet
         self.schema = schema
         self.kw = kw
+
+
+def _scans_system_table(logical) -> bool:
+    """Does this logical plan reference any system.* table
+    (docs/observability.md)? Such plans bypass the physical-plan cache —
+    their scans must re-materialize fresh rows every execution."""
+    from ballista_tpu.obs.history import SYSTEM_TABLE_SCHEMAS
+    from ballista_tpu.plan.logical import TableScan
+
+    def walk(p) -> bool:
+        if isinstance(p, TableScan) and p.table_name in SYSTEM_TABLE_SCHEMAS:
+            return True
+        return any(walk(c) for c in p.children())
+
+    return walk(logical)
 
 
 class TpuContext(Catalog, TableProvider):
@@ -103,6 +121,15 @@ class TpuContext(Catalog, TableProvider):
         # re-traces every per-instance jit (~0.2s/query of pure Python
         # lowering on q6-sized plans, and it grows with plan size)
         self._physical_cache: dict = {}
+        # queryable history (docs/observability.md): the local engine's
+        # own query log — every collect records a history row with its
+        # measured cost vector, and the system.queries /
+        # system.task_attempts tables are materialized from it on scan.
+        # Lazily created (MemoryBackend; the distributed BallistaContext
+        # overrides the system-table source with the scheduler's
+        # persistent log instead).
+        self._local_history = None
+        self._local_query_seq = 0
 
     def mesh_runtime(self):
         """The ICI collective-shuffle runtime, when this process sees >= 2
@@ -176,8 +203,81 @@ class TpuContext(Catalog, TableProvider):
         self._plan_cache.clear()
         self._physical_cache.clear()
 
+    # -- system tables (docs/observability.md) -------------------------------
+    def _system_history(self):
+        """The local query log backing system.queries/system.task_attempts
+        (MemoryBackend: the local context's history is process-scoped;
+        durable history is the scheduler's job)."""
+        if self._local_history is None:
+            from ballista_tpu.obs.history import HistoryStore
+            from ballista_tpu.scheduler.state_backend import MemoryBackend
+
+            self._local_history = HistoryStore(
+                MemoryBackend(),
+                retention_jobs=self.config.history_retention_jobs(),
+            )
+        return self._local_history
+
+    def _system_table_rows(self, name: str) -> list[dict]:
+        """The current rows of one system table. The distributed context
+        overrides this to fetch the scheduler's persistent log."""
+        from ballista_tpu.obs.history import SYSTEM_TABLE_KINDS
+
+        kind = SYSTEM_TABLE_KINDS[name]
+        if kind == "queries":
+            return self._system_history().jobs()
+        if kind == "task_attempts":
+            return self._system_history().attempts()
+        return []  # no cluster: the local engine has no executor roster
+
+    def _refresh_system_table(self, name: str) -> None:
+        """Materialize one system table's CURRENT rows as the registered
+        memory table the ordinary scan path serves. Registered directly
+        (not register_table): a refresh must not clear the plan caches —
+        the physical-plan cache key already varies with the fresh table
+        object via _data_version, so stale plans can never be served."""
+        from ballista_tpu.obs import history as obs_history
+
+        t = obs_history.system_table(name, self._system_table_rows(name))
+        self.tables[name] = _Registered(
+            "memory", obs_history.SYSTEM_TABLE_SCHEMAS[name], table=t
+        )
+
+    def _log_local_query(self, phys, wall_s: float, cpu_s: float,
+                         compile_s: float) -> None:
+        """Record one completed local collect into the query log —
+        the engine observing itself through the same record shape the
+        scheduler persists. Guarded by the caller."""
+        from ballista_tpu.obs import history as obs_history
+        from ballista_tpu.obs.qclass import plan_class
+
+        import time as _time
+
+        hist = self._system_history()
+        self._local_query_seq += 1
+        job_id = f"local-{self._local_query_seq:06d}"
+        now = _time.time()
+        cost = obs_history.cost_from_run(
+            wall_seconds=wall_s, cpu_seconds=cpu_s, plan=phys,
+            compile_seconds=compile_s,
+        )
+        qclass = plan_class(phys)
+        hist.record_submit(
+            job_id, query_class=qclass, submitted_s=now - wall_s
+        )
+        hist.record_terminal(
+            job_id, "completed", query_class=qclass,
+            submitted_s=now - wall_s, latency_s=wall_s, cost=cost,
+        )
+
     # -- Catalog / TableProvider ---------------------------------------------
     def schema_of(self, table: str) -> Schema:
+        from ballista_tpu.obs.history import SYSTEM_TABLE_SCHEMAS
+
+        if table in SYSTEM_TABLE_SCHEMAS:
+            # static schema — no fetch at plan time; scan() materializes
+            # the fresh rows when the query actually executes
+            return SYSTEM_TABLE_SCHEMAS[table]
         if table not in self.tables:
             raise PlanError(f"table {table!r} not found")
         return self.tables[table].schema
@@ -193,6 +293,14 @@ class TpuContext(Catalog, TableProvider):
     def scan(
         self, table: str, projection: list[str] | None, partitions: int
     ) -> ExecutionPlan:
+        from ballista_tpu.obs.history import SYSTEM_TABLE_SCHEMAS
+
+        if table in SYSTEM_TABLE_SCHEMAS:
+            # refresh-on-scan: a system table always serves the rows as
+            # of THIS query's planning, through the ordinary memory-scan
+            # path (planlint verification and execution see nothing
+            # special about it)
+            self._refresh_system_table(table)
         r = self.tables.get(table)
         if r is None:
             raise PlanError(f"table {table!r} not found")
@@ -288,11 +396,19 @@ class TpuContext(Catalog, TableProvider):
         """Registered-data signature for the physical-plan cache key: a
         swapped memory table (object identity + row count) or a rewritten
         file (mtime) must produce a fresh plan — cached scan operators
-        snapshot their table at construction."""
+        snapshot their table at construction. System tables are EXCLUDED:
+        refresh-on-scan re-registers them every query, and letting that
+        churn the signature would invalidate every cached user plan each
+        time a dashboard polls system.queries (plans that scan a system
+        table are never cached at all — see create_physical_plan)."""
         import os
+
+        from ballista_tpu.obs.history import SYSTEM_TABLE_SCHEMAS
 
         sig = []
         for name in sorted(self.tables):
+            if name in SYSTEM_TABLE_SCHEMAS:
+                continue
             r = self.tables[name]
             t = r.kw.get("table")
             if t is not None:
@@ -329,7 +445,10 @@ class TpuContext(Catalog, TableProvider):
         except Exception:
             fp = None  # unserializable plan: just plan it fresh
         key = None
-        if fp is not None:
+        if fp is not None and not _scans_system_table(optimized):
+            # plans over system tables are NEVER cached: a cached scan
+            # operator snapshots the rows it was planned against, and a
+            # system table must serve the rows as of THIS query
             key = (fp, tuple(sorted(self.config.settings().items())),
                    self._data_version())
             cached = self._physical_cache.get(key)
@@ -754,10 +873,38 @@ class DataFrame:
         self.ctx._hints.load_once(
             self.ctx._capacity_hint, self.ctx._plan_cache
         )
-        record_batches = run_with_capacity_retry(
-            self.ctx.config, run, hint=self.ctx._capacity_hint,
-            plan_cache=self.ctx._plan_cache
-        )
+        # cost accounting (docs/observability.md): wall/CPU measured
+        # around the run plus a process compile-seconds delta (a DELTA,
+        # not a claim — in-proc standalone clusters' executor tasks own
+        # the exactly-once claim ledger), logged with the query-class
+        # fingerprint into the local query log system.queries serves
+        import time as _time
+
+        accounting = self.ctx.config.cost_accounting()
+        if accounting:
+            from ballista_tpu.compilecache import metrics as compile_metrics
+
+            t0, c0 = _time.perf_counter(), _time.thread_time()
+            with compile_metrics.delta() as comp_d:
+                record_batches = run_with_capacity_retry(
+                    self.ctx.config, run, hint=self.ctx._capacity_hint,
+                    plan_cache=self.ctx._plan_cache
+                )
+            try:
+                self.ctx._log_local_query(
+                    phys,
+                    _time.perf_counter() - t0,
+                    _time.thread_time() - c0,
+                    float(comp_d.value.get("compile_seconds", 0.0)),
+                )
+            except Exception:  # noqa: BLE001 — the query log is
+                # observability; it must never fail a collect
+                log.exception("local query-log record failed")
+        else:
+            record_batches = run_with_capacity_retry(
+                self.ctx.config, run, hint=self.ctx._capacity_hint,
+                plan_cache=self.ctx._plan_cache
+            )
         self.ctx._hints.save_if_changed(
             self.ctx._capacity_hint, self.ctx._plan_cache
         )

@@ -82,6 +82,12 @@ class Executor:
         # ballista.tpu.metrics_collector (shipping by default); an
         # explicitly constructed collector wins (tests, embedders)
         self.metrics_collector = metrics_collector
+        # cost accounting (docs/observability.md): latch the compile-
+        # seconds claim baseline NOW so AOT prewarm / import-time jits
+        # are never charged to the first task attempt
+        from ballista_tpu.obs import history as obs_history
+
+        obs_history.init_compile_claim()
 
     # -- eager-shuffle location polling (docs/shuffle.md) --------------------
     def _locations_client(self):
@@ -313,6 +319,7 @@ class Executor:
             )
 
         run_t0 = time.perf_counter()
+        cpu_t0 = time.thread_time()
         with span_cm:
             out = run_with_capacity_retry(
                 config,
@@ -372,7 +379,24 @@ class Executor:
             task.task_id.job_id, task.task_id.stage_id,
             task.task_id.partition_id, plan,
         )
-        return TaskRunOutput(partitions=out, operator_metrics=op_metrics)
+        # cost accounting (docs/observability.md): this attempt's
+        # resource vector — wall/CPU around the run, the plan's
+        # data-plane counters (shuffle read, spill, push), the committed
+        # output bytes, and the claimed share of process compile time.
+        # Off = no measurement, no cost on the wire.
+        cost = None
+        if config.cost_accounting():
+            from ballista_tpu.obs import history as obs_history
+
+            cost = obs_history.cost_from_run(
+                wall_seconds=time.perf_counter() - run_t0,
+                cpu_seconds=time.thread_time() - cpu_t0,
+                plan=plan,
+                partitions=out,
+            )
+        return TaskRunOutput(
+            partitions=out, operator_metrics=op_metrics, cost=cost
+        )
 
     @staticmethod
     def _committed_hash(task: pb.TaskDefinition, m) -> str | None:
@@ -407,6 +431,26 @@ class Executor:
         return digest
 
 
+def failed_attempt_cost(task: pb.TaskDefinition, wall_s: float,
+                        cpu_s: float):
+    """Cost vector for a FAILED attempt: wall/CPU metered by the runner
+    loop around the call plus the claimed compile share — the plan's
+    data-plane counters died with the attempt. Honors the session's
+    cost_accounting knob read off the raw task props (the parsed config
+    never materialized for a failed decode), so knob-off sessions ship
+    no cost even on failure."""
+    from ballista_tpu.config import BALLISTA_COST_ACCOUNTING
+
+    for kv in task.props:
+        if kv.key == BALLISTA_COST_ACCOUNTING and kv.value.lower() in (
+            "false", "0", "no"
+        ):
+            return None
+    from ballista_tpu.obs import history as obs_history
+
+    return obs_history.cost_from_run(wall_seconds=wall_s, cpu_seconds=cpu_s)
+
+
 @dataclasses.dataclass
 class TaskRunOutput:
     """What one task attempt produced: the written shuffle partition metas
@@ -416,6 +460,9 @@ class TaskRunOutput:
 
     partitions: list
     operator_metrics: list | None = None
+    # this attempt's resource cost vector (obs.history.CostVector), or
+    # None when the session turned accounting off
+    cost: object = None
 
     def __iter__(self):
         return iter(self.partitions)
@@ -425,13 +472,26 @@ class TaskRunOutput:
 
 
 def as_task_status(
-    task_id: pb.PartitionId, executor_id: str, result, error: str | None
+    task_id: pb.PartitionId,
+    executor_id: str,
+    result,
+    error: str | None,
+    cost=None,
 ) -> pb.TaskStatus:
     """ref executor/src/lib.rs:39-68. ``result``: a TaskRunOutput (the
-    executor path) or a bare meta list (tests / legacy callers)."""
+    executor path) or a bare meta list (tests / legacy callers).
+    ``cost``: a failed attempt's measured CostVector (the runner loops
+    meter wall/CPU around the call so retried attempts still charge);
+    completed attempts carry their cost on the TaskRunOutput."""
+    from ballista_tpu.obs.history import cost_to_proto
+
     st = pb.TaskStatus(task_id=task_id)
     if error is not None:
-        st.failed.CopyFrom(pb.FailedTask(error=error[:4096]))
+        failed = pb.FailedTask(error=error[:4096])
+        cost_p = cost_to_proto(cost)
+        if cost_p is not None:
+            failed.cost.CopyFrom(cost_p)
+        st.failed.CopyFrom(failed)
         return st
     st.completed.CopyFrom(
         pb.CompletedTask(
@@ -456,6 +516,9 @@ def as_task_status(
         st.completed.operator_metrics.extend(
             profile.metrics_to_proto(op_metrics)
         )
+    cost_p = cost_to_proto(getattr(result, "cost", None))
+    if cost_p is not None:
+        st.completed.cost.CopyFrom(cost_p)
     return st
 
 
@@ -625,16 +688,24 @@ class PollLoop:
         def work():
             error = None
             result = []
+            cost = None
+            t0, c0 = time.perf_counter(), time.thread_time()
             try:
                 result = self.executor.execute_shuffle_write(task)
             except BaseException as e:  # noqa: BLE001 (catch_unwind parity)
                 error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
                 log.error("task %s failed: %s", task.task_id, error)
+                # the failed attempt still consumed resources — charge it
+                # (docs/observability.md cost accounting)
+                cost = failed_attempt_cost(
+                    task, time.perf_counter() - t0, time.thread_time() - c0
+                )
             finally:
                 self._available.release()
             self._statuses.put(
                 as_task_status(
-                    task.task_id, self.executor.executor_id, result, error
+                    task.task_id, self.executor.executor_id, result, error,
+                    cost=cost,
                 )
             )
 

@@ -18,7 +18,11 @@ import traceback
 
 import grpc
 
-from ballista_tpu.executor.executor import Executor, as_task_status
+from ballista_tpu.executor.executor import (
+    Executor,
+    as_task_status,
+    failed_attempt_cost,
+)
 from ballista_tpu.executor import (
     effective_task_slots,
     visible_devices,
@@ -242,13 +246,25 @@ class ExecutorServer:
                 continue
             error = None
             result = []
+            cost = None
+            import time as _time
+
+            t0, c0 = _time.perf_counter(), _time.thread_time()
             try:
                 result = self.executor.execute_shuffle_write(task)
             except BaseException as e:  # noqa: BLE001 (catch_unwind parity)
                 error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
                 log.error("task %s failed: %s", task.task_id, error)
+                # failed attempts still consumed resources — charge them
+                # (docs/observability.md cost accounting)
+                cost = failed_attempt_cost(
+                    task,
+                    _time.perf_counter() - t0,
+                    _time.thread_time() - c0,
+                )
             status = as_task_status(
-                task.task_id, self.executor.executor_id, result, error
+                task.task_id, self.executor.executor_id, result, error,
+                cost=cost,
             )
             from ballista_tpu.obs import trace as obs_trace
 

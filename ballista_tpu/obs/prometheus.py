@@ -212,6 +212,30 @@ def scheduler_families(server) -> list[tuple]:
          "cap was reached (no-silent-caps accounting)",
          [({}, overflow)])
     )
+    # cost accounting (docs/observability.md): per-query-class resource
+    # rollup — the charging/fair-share substrate, scrapable
+    with server._lock:
+        class_cost = {
+            c: dict(m) for c, m in server.obs_class_cost.items()
+        }
+    cost_samples = [
+        ({"class": c, "resource": k}, v)
+        for c in sorted(class_cost)
+        for k, v in sorted(class_cost[c].items())
+    ]
+    families.append(
+        ("ballista_job_cost_total", "counter",
+         "Aggregated per-attempt resource cost by query class and "
+         "resource dimension (wall/cpu/compile seconds, shuffle read/"
+         "write, pushed, spill bytes) — failed and recomputed attempts "
+         "included", cost_samples or [({}, 0)])
+    )
+    families.append(
+        ("ballista_history_jobs", "gauge",
+         "Jobs currently retained in the persistent query-history log "
+         "(bounded by ballista.tpu.history_retention_jobs)",
+         [({}, server.history.job_count())])
+    )
     families.append(
         ("ballista_desired_executors", "gauge",
          "Composite autoscale pressure: executors the KEDA ExternalScaler "

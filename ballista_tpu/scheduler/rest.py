@@ -304,6 +304,10 @@ def job_detail(server, job_id: str) -> dict | None:
                 {"stage_id": s, "partition": p}
                 for s, p in sorted(job.skew_flags)
             ],
+            # aggregated resource cost (docs/observability.md): every
+            # attempt's shipped cost vector summed — the same numbers
+            # the history record persists
+            "cost": job.cost.to_dict() if job.cost is not None else {},
         }
     # stats/trace aggregation takes the server lock itself — outside the
     # block above (the lock is reentrant, but the narrower the section
@@ -334,6 +338,20 @@ def job_timeline(server, job_id: str) -> dict | None:
         if job is None:
             return None
         skew = set(job.skew_flags)
+        # push-shuffle data-plane counters per (stage, partition) from
+        # the shipped per-operator metrics (docs/shuffle.md): how many
+        # bytes each task committed in memory, spilled under window
+        # pressure, or made consumers fall back to the pull plane
+        push_by_task: dict = {}
+        for (sid, part), records in job.op_metrics.items():
+            agg = {"pushed_bytes": 0, "push_spill_bytes": 0,
+                   "push_fallbacks": 0}
+            for r in records:
+                for k in agg:
+                    v = r.get("counters", {}).get(k)
+                    if isinstance(v, (int, float)):
+                        agg[k] += int(v)
+            push_by_task[(sid, part)] = agg
     stages = job.stage_stats
     if stages is None:
         stages = server.stage_manager.job_stage_detail(job_id)
@@ -368,6 +386,11 @@ def job_timeline(server, job_id: str) -> dict | None:
                 and now - start > threshold
             ):
                 straggler = True  # live projection, not yet committed
+            push = push_by_task.get(
+                (st["stage_id"], t["partition"]),
+                {"pushed_bytes": 0, "push_spill_bytes": 0,
+                 "push_fallbacks": 0},
+            )
             tasks.append(
                 {
                     "stage_id": st["stage_id"],
@@ -380,6 +403,10 @@ def job_timeline(server, job_id: str) -> dict | None:
                     "duration_s": round(max(0.0, dur), 6),
                     "straggler": straggler,
                     "skewed": (st["stage_id"], t["partition"]) in skew,
+                    # push data-plane visibility (docs/shuffle.md)
+                    "pushed_bytes": push["pushed_bytes"],
+                    "push_spill_bytes": push["push_spill_bytes"],
+                    "push_fallbacks": push["push_fallbacks"],
                 }
             )
     return {
@@ -408,6 +435,30 @@ def start_rest_server(server, host: str = "0.0.0.0", port: int = 0):
             path = self.path.split("?", 1)[0].rstrip("/") or "/"
             if path in ("/api/state", "/state"):
                 body = json.dumps(scheduler_state(server)).encode()
+                ctype = "application/json"
+            elif path in ("/api/history", "/history"):
+                # the persistent query log (docs/observability.md):
+                # ?kind=queries|task_attempts|executors, ?limit=N
+                from urllib.parse import parse_qs, urlparse
+
+                q = parse_qs(urlparse(self.path).query)
+                kind = (q.get("kind") or ["queries"])[0]
+                try:
+                    limit = int((q.get("limit") or ["0"])[0])
+                except ValueError:
+                    limit = 0
+                try:
+                    rows = server.history_payload(kind, limit)
+                except ValueError:
+                    self._reply(
+                        400,
+                        json.dumps(
+                            {"error": "unknown kind", "kind": kind}
+                        ).encode(),
+                        "application/json",
+                    )
+                    return
+                body = json.dumps({"kind": kind, "rows": rows}).encode()
                 ctype = "application/json"
             elif path in ("/api/metrics", "/metrics"):
                 # the scrapeable metrics plane (docs/observability.md):

@@ -26,6 +26,10 @@ SCHEDULER_METHODS = {
     # eager shuffle (docs/shuffle.md): executors poll published map-output
     # locations of a still-running producer stage
     "GetShuffleLocations": (pb.FetchPartition, pb.ShuffleLocationsResult),
+    # queryable history (docs/observability.md): clients fetch the
+    # persistent query log / cost records / executor roster backing the
+    # system.* SQL tables
+    "GetHistory": (pb.GetHistoryParams, pb.GetHistoryResult),
 }
 
 EXECUTOR_METHODS = {

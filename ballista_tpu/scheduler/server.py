@@ -170,6 +170,12 @@ class JobInfo:
     submitted_s: float = 0.0
     first_assign_s: float = 0.0
     skew_flags: list = dataclasses.field(default_factory=list)
+    # cost accounting (docs/observability.md): the job's aggregated
+    # resource cost vector (obs.history.CostVector), summed from every
+    # attempt's shipped cost — failed/retried/recomputed attempts
+    # included, because the tenant paid for them too. None until the
+    # first costed attempt reports (accounting off = stays None).
+    cost: object = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -334,6 +340,30 @@ class SchedulerServer:
         self._known_classes: set[str] = set()
         self.max_query_classes = 256
         self.obs_class_overflow = 0
+        # per-query-class resource-cost rollup (docs/observability.md):
+        # the ballista_job_cost_total counter family /api/metrics serves.
+        # Guarded by _lock like the other obs aggregations.
+        self.obs_class_cost: dict[str, dict[str, float]] = {}
+        # queryable history (docs/observability.md): the append-only
+        # job-lifecycle log. Written through the SAME state backend the
+        # scheduler persists to — on sqlite/etcd it survives restarts;
+        # without a configured backend an in-process MemoryBackend keeps
+        # the surface (REST /api/history, system.queries) alive for the
+        # process lifetime. Constructed BEFORE _recover_state so recovery
+        # can terminal-record jobs that died with the old scheduler.
+        from ballista_tpu.obs.history import HistoryStore
+
+        if state_backend is None:
+            from ballista_tpu.scheduler.state_backend import MemoryBackend
+
+            history_backend = MemoryBackend()
+        else:
+            history_backend = state_backend
+        self.history = HistoryStore(
+            history_backend,
+            namespace,
+            retention_jobs=self.config.history_retention_jobs(),
+        )
         self.state = None
         if state_backend is not None:
             from ballista_tpu.scheduler.persistent_state import (
@@ -480,6 +510,21 @@ class SchedulerServer:
                     job.status = "failed"
                     job.error = "scheduler restarted while job was in flight"
                     self.state.save_job(job)
+                    # the history log must agree with the job record: the
+                    # predecessor wrote "submitted" but never a terminal
+                    # record — close it out so system.queries never shows
+                    # an eternally-submitted ghost
+                    try:
+                        self.history.record_terminal(
+                            job.job_id, "failed", error=job.error,
+                            session_id=job.session_id,
+                        )
+                    except Exception:  # noqa: BLE001 — history is
+                        # observability, never recovery-critical
+                        log.exception(
+                            "history terminal record failed for %s",
+                            job.job_id,
+                        )
                 self.jobs[job.job_id] = job
             if self.jobs:
                 log.info(
@@ -668,6 +713,16 @@ class SchedulerServer:
             self.jobs[job_id] = job
             if self.state is not None:
                 self.state.save_job(job)
+        # history log (docs/observability.md): the submit record — written
+        # OUTSIDE the lock (backend I/O) and guarded (history is
+        # observability, never submission-critical)
+        try:
+            self.history.record_submit(
+                job_id, query_class=qclass, session_id=session_id,
+                submitted_s=now,
+            )
+        except Exception:  # noqa: BLE001
+            log.exception("history submit record failed for %s", job_id)
         self.event_loop.post(JobSubmitted(job_id, physical))
         return job_id
 
@@ -774,6 +829,111 @@ class SchedulerServer:
                         self.obs_task_counters[k] = (
                             self.obs_task_counters.get(k, 0) + v
                         )
+
+    def _ingest_task_cost(self, tid: PartitionId, state: str,
+                          executor_id: str, cost_msg) -> None:
+        """One attempt's shipped cost vector (docs/observability.md):
+        summed into the job's aggregate, rolled up per query class for
+        the Prometheus cost counters, and appended to the history log as
+        a task-attempt record. ``cost_msg`` is the CostVectorP or None
+        (accounting off)."""
+        if cost_msg is None:
+            return
+        from ballista_tpu.obs.history import CostVector, cost_from_proto
+
+        cost = cost_from_proto(cost_msg)
+        if cost.is_zero():
+            return
+        job = self._get_job(tid.job_id)
+        qclass = job.query_class if job is not None else "unknown"
+        with self._lock:
+            if job is not None:
+                if job.cost is None:
+                    job.cost = CostVector()
+                job.cost.add(cost)
+            rollup = self.obs_class_cost.setdefault(qclass, {})
+            for k, v in cost.to_dict().items():
+                rollup[k] = rollup.get(k, 0) + v
+        try:
+            self.history.record_attempt(
+                tid.job_id, tid.stage_id, tid.partition_id, state,
+                executor_id, cost,
+            )
+        except Exception:  # noqa: BLE001 — metering must never outrank
+            # the status RPC it rides along with
+            log.exception("history attempt record failed for %s", tid)
+
+    def _job_terminal_history(self, job: JobInfo, status: str) -> None:
+        """Write the job's terminal history record (completed|failed):
+        latency/queue-wait, retry/recompute/straggler/skew counters, and
+        the aggregated cost vector. Guarded by callers."""
+        import time as _time
+
+        now = _time.time()
+        latency = max(0.0, now - job.submitted_s) if job.submitted_s else 0.0
+        wait = 0.0
+        if job.first_assign_s and job.submitted_s:
+            wait = max(0.0, job.first_assign_s - job.submitted_s)
+        stragglers = 0
+        for st in job.stage_stats or []:
+            stragglers += sum(1 for t in st["tasks"] if t.get("straggler"))
+        with self._lock:
+            cost = job.cost
+            skew = len(job.skew_flags)
+        self.history.record_terminal(
+            job.job_id,
+            status,
+            query_class=job.query_class,
+            session_id=job.session_id,
+            submitted_s=job.submitted_s,
+            latency_s=latency,
+            queue_wait_s=wait,
+            retries=job.total_retries,
+            recomputes=job.total_recomputes,
+            stragglers=stragglers,
+            skew_partitions=skew,
+            error=job.error,
+            cost=cost,
+        )
+
+    def history_payload(self, kind: str = "queries",
+                        limit: int = 0) -> list[dict]:
+        """The rows behind ``GET /api/history`` and the GetHistory RPC —
+        one payload shape for every ``system.*`` table source."""
+        if kind in ("", "queries"):
+            return self.history.jobs(limit)
+        if kind == "task_attempts":
+            return self.history.attempts(limit)
+        if kind == "executors":
+            import time as _time
+
+            em = self.executor_manager
+            now = _time.time()
+            alive = em.get_alive_executors(self.executor_timeout_s)
+            rows = []
+            for meta in em.all_executors():
+                data = em.get_executor_data(meta.id)
+                seen = em.last_seen(meta.id)
+                rows.append(
+                    {
+                        "id": meta.id,
+                        "host": meta.host,
+                        "port": meta.port,
+                        "grpc_port": meta.grpc_port,
+                        "task_slots": (
+                            data.total_task_slots if data
+                            else meta.specification.task_slots
+                        ),
+                        "n_devices": meta.specification.n_devices or 1,
+                        "alive": meta.id in alive,
+                        "last_heartbeat_age_s": (
+                            round(now - seen, 3) if seen is not None
+                            else -1.0
+                        ),
+                    }
+                )
+            return rows[:limit] if limit else rows
+        raise ValueError(f"unknown history kind {kind!r}")
 
     def ingest_hists(self, hist_protos) -> None:
         """Executor-shipped latency-histogram deltas (poll/heartbeat
@@ -1519,6 +1679,14 @@ class SchedulerServer:
         job.stage_stats = self.stage_manager.job_stage_detail(job_id)
         self._close_job_trace(job, "ok")
         self._retain_job_obs(job)
+        # history log: exactly ONE terminal record per job, carrying the
+        # latency/queue-wait/retry/skew counters and the aggregated cost
+        # vector — the durable row system.queries serves
+        try:
+            self._job_terminal_history(job, "completed")
+        except Exception:  # noqa: BLE001 — observability, never
+            # completion-critical
+            log.exception("history record failed for %s", job_id)
         # locations are snapshotted on the JobInfo; dropping the stage
         # bookkeeping zeroes the inflight count (KEDA's scale signal) and
         # stops fetch_schedulable_stage from ever seeing this job again
@@ -1534,6 +1702,11 @@ class SchedulerServer:
         job.stage_stats = self.stage_manager.job_stage_detail(job_id)
         self._close_job_trace(job, "error")
         self._retain_job_obs(job)
+        try:
+            self._job_terminal_history(job, "failed")
+        except Exception:  # noqa: BLE001 — the failure path must not
+            # fail on its own bookkeeping
+            log.exception("history record failed for %s", job_id)
         # stage cleanup FIRST, and the write-through guarded: failure may
         # be the persistence backend itself, and skipping cleanup would
         # leave the failed job's PENDING tasks schedulable forever (push
@@ -1912,6 +2085,19 @@ class SchedulerServer:
                 self._ingest_task_metrics(
                     tid.job_id, tid.stage_id, tid.partition_id, st
                 )
+                # cost accounting: the attempt's resource vector sums
+                # into the job + class rollups and the history log.
+                # Guarded like the straggler metering below — an
+                # escaping exception after the transition applied would
+                # wedge the job (see that comment).
+                try:
+                    self._ingest_task_cost(
+                        tid, "completed", st.completed.executor_id,
+                        st.completed.cost
+                        if st.completed.HasField("cost") else None,
+                    )
+                except Exception:  # noqa: BLE001
+                    log.exception("task-cost ingest failed for %s", tid)
                 # fleet plane: stage-task duration histogram + the
                 # straggler check, both off the just-closed window.
                 # Guarded: an escaping metering exception here would
@@ -1954,6 +2140,16 @@ class SchedulerServer:
                     # progress, exactly like barriered waiting.
                     eager_timeout = "[eager-wait-timeout]" in error
                     count_attempt = not (recovered or eager_timeout)
+                # failed attempts charge their cost too (retries are
+                # exactly the attempts a tenant should see billed)
+                try:
+                    self._ingest_task_cost(
+                        tid, "failed", "",
+                        st.failed.cost
+                        if st.failed.HasField("cost") else None,
+                    )
+                except Exception:  # noqa: BLE001
+                    log.exception("task-cost ingest failed for %s", tid)
                 events = self.stage_manager.update_task_status(
                     tid,
                     TaskState.FAILED,
@@ -2238,6 +2434,23 @@ class SchedulerGrpcServicer:
         return self.s.shuffle_locations_proto(
             request.job_id, request.stage_id, request.partition_id
         )
+
+    def GetHistory(self, request, context):
+        """Queryable history (docs/observability.md): the persistent
+        query log / per-attempt cost records / executor roster, as JSON
+        rows — the source the client-side system.* SQL tables
+        materialize from."""
+        import json as _json
+
+        try:
+            rows = self.s.history_payload(
+                request.kind or "queries", int(request.limit)
+            )
+        except ValueError as e:
+            import grpc as _grpc
+
+            context.abort(_grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.GetHistoryResult(payload=_json.dumps(rows).encode())
 
 
 def start_scheduler_grpc(

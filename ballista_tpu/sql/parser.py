@@ -115,6 +115,16 @@ class Parser:
         raise SqlError(f"expected identifier but found {t.value!r} at offset {t.pos}")
 
     # -- statements ----------------------------------------------------------
+    def parse_table_name(self) -> str:
+        """A possibly schema-qualified table name (``system.queries``):
+        dot-joined identifiers stored as ONE flat registry name — the
+        catalog has no schema hierarchy, the dotted string IS the key
+        (docs/observability.md system tables)."""
+        name = self.expect_ident()
+        while self.accept_punct("."):
+            name = f"{name}.{self.expect_ident()}"
+        return name
+
     def parse_statement(self) -> ast.Statement:
         stmt = self._statement()
         self.accept_punct(";")
@@ -135,7 +145,7 @@ class Parser:
             return self.parse_show()
         if t.is_kw("describe"):
             self.next()
-            return ast.ShowColumns(self.expect_ident())
+            return ast.ShowColumns(self.parse_table_name())
         if t.is_kw("explain"):
             self.next()
             verbose = self.accept_kw("verbose")
@@ -212,7 +222,7 @@ class Parser:
         if self.accept_kw("if"):
             self.expect_kw("exists")
             if_exists = True
-        return ast.DropTable(self.expect_ident(), if_exists)
+        return ast.DropTable(self.parse_table_name(), if_exists)
 
     def parse_show(self) -> ast.Statement:
         self.expect_kw("show")
@@ -220,7 +230,7 @@ class Parser:
             return ast.ShowTables()
         if self.accept_kw("columns"):
             self.expect_kw("from")
-            return ast.ShowColumns(self.expect_ident())
+            return ast.ShowColumns(self.parse_table_name())
         raise SqlError("expected SHOW TABLES or SHOW COLUMNS FROM <table>")
 
     def parse_type_name(self) -> DataType:
@@ -405,7 +415,7 @@ class Parser:
             self.accept_kw("as")
             alias = self.expect_ident()
             return ast.Derived(q, alias)
-        name = self.expect_ident()
+        name = self.parse_table_name()
         alias = None
         if self.accept_kw("as"):
             alias = self.expect_ident()

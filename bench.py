@@ -162,6 +162,28 @@ def _plan_counters(phys) -> dict:
     }
 
 
+def _cost_fields(history_store) -> dict:
+    """Tracked cost fields (docs/observability.md "Cost accounting"):
+    the newest query-log record's cost vector — the perf trajectory
+    records efficiency (cpu/shuffle/spill) alongside latency in every
+    BENCH_* per-query plan artifact. Reads the engine's OWN accounting
+    (the local context's query log, or a cluster scheduler's history
+    store) instead of re-measuring."""
+    try:
+        rows = history_store.jobs(limit=1)
+    except Exception:  # noqa: BLE001 — accounting off / empty store
+        return {}
+    if not rows:
+        return {}
+    cost = rows[0].get("cost") or {}
+    return {
+        "cpu_seconds": round(float(cost.get("cpu_seconds", 0)), 4),
+        "shuffle_bytes": int(cost.get("shuffle_read_bytes", 0))
+        + int(cost.get("shuffle_write_bytes", 0)),
+        "spill_bytes": int(cost.get("spill_bytes", 0)),
+    }
+
+
 def _collect_with_plan(ctx, sql: str):
     """(table, rows, executed plan) — the plan so per-query metrics
     (spill bytes, prefetch hit ratio) can be read AFTER the run."""
@@ -249,6 +271,9 @@ def run_suite() -> dict:
             "warm_retraces": int(warm_d.value.get("traces", 0)),
             **counters,
         }
+        # tracked cost fields (docs/observability.md): the final warm
+        # pass's cost vector from the context's own query log
+        q.update(_cost_fields(ctx._system_history()))
         hits = counters.get("prefetch_hits", 0)
         misses = counters.get("prefetch_misses", 0)
         if hits + misses:
@@ -715,6 +740,7 @@ def run_sf100_suite() -> dict:
             for name, t in data.items():
                 ctx.register_table(name, t)
             times = {}
+            costs = {}
             for qn in qns:
                 sql = (QDIR / f"{qn}.sql").read_text()
                 ctx.sql(sql).collect()  # cold/compile pass
@@ -725,10 +751,15 @@ def run_sf100_suite() -> dict:
                     dt = time.time() - t0
                     best = dt if best is None else min(best, dt)
                 times[qn] = best
+                # tracked cost fields: the last warm run's record from
+                # the scheduler's persistent history
+                costs[qn] = _cost_fields(
+                    ctx._standalone_cluster.scheduler.history
+                )
             counters = dict(
                 ctx._standalone_cluster.scheduler.obs_task_counters
             )
-            return times, counters
+            return times, counters, costs
         finally:
             ctx.close()
 
@@ -747,7 +778,7 @@ def run_sf100_suite() -> dict:
     }
 
     # -- headline: committed defaults (push plane on) ----------------------
-    times, counters = run_arm({}, qnames)
+    times, counters, costs = run_arm({}, qnames)
     total = sum(times.values())
     shuffle_keys = (
         "fetched_bytes", "pushed_bytes", "push_spill_bytes",
@@ -755,6 +786,9 @@ def run_sf100_suite() -> dict:
     )
     out["headline"] = {
         "per_query_s": {q: round(s, 4) for q, s in times.items()},
+        # cost fields per query (docs/observability.md): cpu/shuffle/
+        # spill from the scheduler's persistent history records
+        "per_query_cost": costs,
         "total_warm_s": round(total, 4),
         "queries_per_sec": round(len(times) / total, 4),
         "task_counters": {
@@ -789,10 +823,10 @@ def run_sf100_suite() -> dict:
     # -- push vs pull under full queries (informational) -------------------
     wire_qs = [q for q in qnames if q != "q1"] or qnames
     wire = {"ballista.tpu.shuffle_local_fastpath": "false"}
-    pull_times, pull_counters = run_arm(
+    pull_times, pull_counters, _ = run_arm(
         {**wire, "ballista.tpu.push_shuffle": "false"}, wire_qs
     )
-    push_times, push_counters = run_arm(
+    push_times, push_counters, _ = run_arm(
         {**wire, "ballista.tpu.push_shuffle": "true"}, wire_qs
     )
     out["push_vs_pull_queries"] = {
@@ -1360,6 +1394,10 @@ def run_compile_suite() -> dict:
             "n_signatures": q.get("n_signatures"),
             "compile_seconds": q.get("compile_seconds"),
             "warm_retraces": q.get("warm_retraces"),
+            # tracked cost fields (docs/observability.md)
+            "cpu_seconds": q.get("cpu_seconds"),
+            "shuffle_bytes": q.get("shuffle_bytes"),
+            "spill_bytes": q.get("spill_bytes"),
         }
     cold_total = round(
         sum(q["cold_s"] for q in warm["queries"].values()), 4

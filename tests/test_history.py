@@ -1,0 +1,522 @@
+"""Queryable history: persistent query log, cost accounting, and the
+system.* SQL tables (ISSUE 14, docs/observability.md).
+
+Unit level: CostVector arithmetic/wire roundtrip, the exactly-once
+compile-seconds claim ledger, HistoryStore lifecycle (one terminal
+record per job, bounded retention, rebuild over an existing backend,
+sqlite reopen), and the dotted-table-name grammar.
+
+Engine level: the local TpuContext's query log feeding system.queries
+through the ordinary (planlint-verified) scan path, and the
+accounting-off inertness contract.
+
+Cluster level (subprocess, like the other distributed tests): the
+acceptance query over a standalone cluster, GET /api/history, the
+timeline's push counters, the Prometheus cost rollup, and the
+durability satellite — history written on the sqlite backend surviving
+a scheduler restart and re-served by /api/history and system.queries.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from tests.conftest import CPU_MESH_ENV
+
+from ballista_tpu.obs import history as H
+from ballista_tpu.scheduler.state_backend import MemoryBackend, SqliteBackend
+
+
+# ---------------------------------------------------------------------------
+# CostVector
+# ---------------------------------------------------------------------------
+
+
+def test_cost_vector_add_and_dict_roundtrip():
+    a = H.CostVector(wall_seconds=1.5, cpu_seconds=0.25,
+                     shuffle_read_bytes=100, spill_bytes=7)
+    b = H.CostVector(wall_seconds=0.5, shuffle_write_bytes=30,
+                     pushed_bytes=30, compile_seconds=0.125)
+    a.add(b)
+    d = a.to_dict()
+    assert d["wall_seconds"] == 2.0
+    assert d["shuffle_read_bytes"] == 100
+    assert d["shuffle_write_bytes"] == 30
+    assert d["pushed_bytes"] == 30
+    assert H.CostVector.from_dict(d).to_dict() == d
+    assert not a.is_zero()
+    assert H.CostVector().is_zero()
+
+
+def test_cost_vector_proto_roundtrip():
+    c = H.CostVector(wall_seconds=1.25, cpu_seconds=0.5,
+                     shuffle_read_bytes=10, shuffle_write_bytes=20,
+                     pushed_bytes=5, spill_bytes=3, compile_seconds=0.75)
+    p = H.cost_to_proto(c)
+    assert H.cost_from_proto(p).to_dict() == c.to_dict()
+    # zero vectors never hit the wire (absent field IS the off path)
+    assert H.cost_to_proto(H.CostVector()) is None
+    assert H.cost_to_proto(None) is None
+
+
+def test_compile_claim_exactly_once():
+    from ballista_tpu.compilecache import metrics as compile_metrics
+
+    H.init_compile_claim()
+    H.claim_compile_seconds()  # drain whatever this process accrued
+    compile_metrics.add("compile_seconds", 1.25)
+    first = H.claim_compile_seconds()
+    assert first >= 1.25 - 1e-9
+    # the same seconds can never be claimed twice
+    assert H.claim_compile_seconds() == 0.0
+
+
+def test_cost_from_run_sums_partition_bytes():
+    class Meta:
+        num_bytes = 64
+
+    c = H.cost_from_run(1.0, 0.5, partitions=[Meta(), Meta()],
+                        compile_seconds=0.0)
+    assert c.shuffle_write_bytes == 128
+    assert c.wall_seconds == 1.0 and c.cpu_seconds == 0.5
+
+
+# ---------------------------------------------------------------------------
+# HistoryStore
+# ---------------------------------------------------------------------------
+
+
+def _filled_store(backend=None, retention=100):
+    hs = H.HistoryStore(backend or MemoryBackend(),
+                        retention_jobs=retention)
+    cost = H.CostVector(wall_seconds=1.0, cpu_seconds=0.5,
+                        shuffle_read_bytes=10)
+    for i in range(3):
+        jid = f"job{i}"
+        hs.record_submit(jid, query_class="qc", session_id="s",
+                         submitted_s=1000.0 + i)
+        hs.record_attempt(jid, 1, 0, "completed", "e1", cost)
+        hs.record_terminal(jid, "completed", query_class="qc",
+                           submitted_s=1000.0 + i, latency_s=0.5,
+                           cost=cost)
+    return hs
+
+
+def test_history_lifecycle_one_terminal_record_per_job():
+    hs = _filled_store()
+    rows = hs.jobs()
+    assert [r["job_id"] for r in rows] == ["job2", "job1", "job0"]
+    for r in rows:
+        assert r["status"] == "completed"
+        assert r["query_class"] == "qc"
+        assert r["cost"]["wall_seconds"] == 1.0
+    for i in range(3):
+        assert hs.complete_record_count(f"job{i}") == 1
+    assert len(hs.attempts()) == 3
+    assert hs.attempts(job_id="job1")[0]["stage_id"] == 1
+    # limit caps newest-first
+    assert [r["job_id"] for r in hs.jobs(limit=1)] == ["job2"]
+
+
+def test_history_failed_jobs_and_submit_only_rows():
+    hs = H.HistoryStore(MemoryBackend())
+    hs.record_submit("jf", query_class="qc", submitted_s=1.0)
+    hs.record_terminal("jf", "failed", error="boom", submitted_s=1.0)
+    hs.record_submit("js", query_class="qc", submitted_s=2.0)
+    rows = {r["job_id"]: r for r in hs.jobs()}
+    assert rows["jf"]["status"] == "failed"
+    assert rows["jf"]["error"] == "boom"
+    # terminal record with default identity fields keeps the submit's
+    # query_class (the restarted-scheduler close-out shape)
+    assert rows["jf"]["query_class"] == "qc"
+    assert rows["js"]["status"] == "submitted"
+
+
+def test_history_retention_drops_oldest_jobs_and_attempts():
+    backend = MemoryBackend()
+    hs = H.HistoryStore(backend, retention_jobs=2)
+    cost = H.CostVector(wall_seconds=1.0)
+    for i in range(5):
+        jid = f"job{i}"
+        hs.record_submit(jid, submitted_s=1000.0 + i)
+        hs.record_attempt(jid, 0, 0, "completed", "e", cost)
+        hs.record_terminal(jid, "completed", submitted_s=1000.0 + i)
+    rows = hs.jobs()
+    assert [r["job_id"] for r in rows] == ["job4", "job3"]
+    # evicted jobs' ATTEMPT records are gone too — compaction is total
+    assert {a["job_id"] for a in hs.attempts()} == {"job3", "job4"}
+    # nothing under the evicted stamps at the raw-KV level
+    evicted = [k for k, _ in backend.get_from_prefix("/ballista")
+               if "job0" in k or "job1" in k or "job2" in k]
+    assert evicted == []
+
+
+def test_history_retention_stamp_prefix_is_exact():
+    """A stamp that is a string prefix of another stamp (same-millisecond
+    submits with embedder-supplied ids like job-1 / job-10) must never
+    match the other job's records during eviction or per-job reads."""
+    hs = H.HistoryStore(MemoryBackend(), retention_jobs=1)
+    cost = H.CostVector(wall_seconds=1.0)
+    # same submit millisecond → stamps differ only by the id suffix
+    hs.record_submit("job-1", submitted_s=1.0)
+    hs.record_attempt("job-1", 0, 0, "completed", "e", cost)
+    hs.record_terminal("job-1", "completed", submitted_s=1.0)
+    hs.record_submit("job-10", submitted_s=1.0)
+    hs.record_attempt("job-10", 0, 0, "completed", "e", cost)
+    hs.record_terminal("job-10", "completed", submitted_s=1.0)
+    # per-job reads stay exact despite the shared prefix
+    assert hs.complete_record_count("job-10") == 1
+    assert {a["job_id"] for a in hs.attempts(job_id="job-10")} == {"job-10"}
+    # retention=1 evicted job-1 (older by key order) WITHOUT touching
+    # job-10's records
+    rows = hs.jobs()
+    assert [r["job_id"] for r in rows] == ["job-10"]
+    assert rows[0]["status"] == "completed"
+    assert hs.job_count() == 1
+
+
+def test_history_rebuild_over_existing_backend():
+    backend = MemoryBackend()
+    hs = H.HistoryStore(backend)
+    hs.record_submit("j1", query_class="qc", submitted_s=5.0)
+    # a NEW store over the same backend (scheduler restart) can close
+    # out the predecessor's in-flight job
+    hs2 = H.HistoryStore(backend)
+    hs2.record_terminal("j1", "failed", error="scheduler restarted")
+    rows = hs2.jobs()
+    assert rows[0]["status"] == "failed"
+    assert rows[0]["query_class"] == "qc"
+
+
+def test_history_sqlite_survives_reopen(tmp_path):
+    path = str(tmp_path / "hist.db")
+    b = SqliteBackend(path)
+    hs = _filled_store(backend=b)
+    assert len(hs.jobs()) == 3
+    b.close()
+    b2 = SqliteBackend(path)
+    hs2 = H.HistoryStore(b2)
+    rows = hs2.jobs()
+    assert [r["job_id"] for r in rows] == ["job2", "job1", "job0"]
+    assert rows[0]["cost"]["cpu_seconds"] == 0.5
+    assert len(hs2.attempts()) == 3
+    b2.close()
+
+
+def test_system_table_builders_and_schemas():
+    hs = _filled_store()
+    t = H.queries_table(hs.jobs())
+    assert t.num_rows == 3
+    assert t.column_names == [f.name for f in H.QUERIES_SCHEMA]
+    # derived shuffle_bytes = read + write
+    assert t.to_pydict()["shuffle_bytes"] == [10, 10, 10]
+    at = H.task_attempts_table(hs.attempts())
+    assert at.num_rows == 3
+    assert at.to_pydict()["state"] == ["completed"] * 3
+    et = H.executors_table([
+        {"id": "e1", "host": "h", "port": 1, "grpc_port": 2,
+         "task_slots": 4, "n_devices": 1, "alive": True,
+         "last_heartbeat_age_s": 0.5}
+    ])
+    assert et.to_pydict()["alive"] == [True]
+    with pytest.raises(KeyError):
+        H.system_table("system.nope", [])
+
+
+# ---------------------------------------------------------------------------
+# grammar: dotted table names
+# ---------------------------------------------------------------------------
+
+
+def test_parser_dotted_table_names():
+    from ballista_tpu.sql import ast
+    from ballista_tpu.sql.parser import parse_sql
+
+    stmt = parse_sql("SELECT status FROM system.queries")
+    assert stmt.from_.name == "system.queries"
+    stmt = parse_sql("SELECT q.status FROM system.queries q")
+    assert stmt.from_.name == "system.queries"
+    assert stmt.from_.alias == "q"
+    sc = parse_sql("SHOW COLUMNS FROM system.queries")
+    assert isinstance(sc, ast.ShowColumns) and sc.table == "system.queries"
+    dt = parse_sql("DROP TABLE IF EXISTS system.queries")
+    assert isinstance(dt, ast.DropTable) and dt.name == "system.queries"
+
+
+# ---------------------------------------------------------------------------
+# local engine: the query log + system tables through the scan path
+# ---------------------------------------------------------------------------
+
+
+def test_local_system_queries_through_engine(tpu_ctx_factory):
+    import pyarrow as pa
+
+    ctx = tpu_ctx_factory()
+    t = pa.table({
+        "k": pa.array(["a", "b", "a", "c"] * 25),
+        "v": pa.array(list(range(100)), type=pa.int64()),
+    })
+    ctx.register_table("t1", t)
+    ctx.sql("SELECT k, sum(v) AS s FROM t1 GROUP BY k").collect()
+    ctx.sql("SELECT count(*) AS n FROM t1").collect()
+    # the acceptance-criterion query shape, through the normal
+    # (planlint-verified: verify_plans defaults on) engine path
+    r = ctx.sql(
+        "SELECT query_class, count(*), sum(cpu_seconds), "
+        "sum(shuffle_bytes) FROM system.queries GROUP BY query_class"
+    ).collect()
+    assert r.num_rows == 2  # two distinct query classes ran
+    d = r.to_pydict()
+    counts = d[r.column_names[1]]
+    assert sorted(counts) == [1, 1]
+    # wall/cpu must be NONZERO — the log measured real work
+    # 3 rows now: the two t1 queries plus the acceptance query above
+    # (the log records every collect, including system-table ones —
+    # each snapshot predates its own record)
+    rows = ctx.sql(
+        "SELECT job_id, status, wall_seconds, cpu_seconds "
+        "FROM system.queries"
+    ).collect().to_pydict()
+    assert len(rows["status"]) == 3
+    assert set(rows["status"]) == {"completed"}
+    assert all(w > 0 for w in rows["wall_seconds"])
+    assert all(c > 0 for c in rows["cpu_seconds"])
+    # the system query itself was logged AFTER its own scan snapshot
+    assert len(ctx._system_history().jobs()) >= 4
+    # empty-but-typed companions work through the same path
+    assert ctx.sql("SELECT id FROM system.executors").collect().num_rows == 0
+    assert ctx.sql(
+        "SELECT job_id FROM system.task_attempts"
+    ).collect().num_rows == 0
+
+
+def test_local_accounting_off_is_inert(tpu_ctx_factory):
+    import pyarrow as pa
+
+    from ballista_tpu.config import BallistaConfig
+
+    ctx = tpu_ctx_factory(
+        BallistaConfig({"ballista.tpu.cost_accounting": "false"})
+    )
+    ctx.register_table("t1", pa.table({"v": pa.array([1, 2, 3])}))
+    ctx.sql("SELECT sum(v) AS s FROM t1").collect()
+    r = ctx.sql("SELECT job_id FROM system.queries").collect()
+    assert r.num_rows == 0  # nothing logged, but the table still serves
+
+
+@pytest.fixture
+def tpu_ctx_factory():
+    from ballista_tpu.exec.context import TpuContext
+
+    def make(cfg=None):
+        return TpuContext(cfg)
+
+    return make
+
+
+# ---------------------------------------------------------------------------
+# cluster level: acceptance + REST + prometheus (subprocess)
+# ---------------------------------------------------------------------------
+
+_DISTRIBUTED_SCRIPT = r"""
+import json
+import urllib.request
+
+import pyarrow as pa
+
+from ballista_tpu.client.context import BallistaContext
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.scheduler.rest import start_rest_server, stop_rest_server
+
+cfg = BallistaConfig().with_setting("ballista.shuffle.partitions", "2")
+ctx = BallistaContext.standalone(cfg, n_executors=1)
+t = pa.table({
+    "k": pa.array(["a", "b", "a", "c"] * 50),
+    "v": pa.array(list(range(200)), type=pa.int64()),
+})
+ctx.register_table("t1", t)
+ctx.sql("SELECT k, sum(v) AS s FROM t1 GROUP BY k").collect()
+ctx.sql("SELECT count(*) AS n FROM t1").collect()
+
+# -- the acceptance query, verbatim shape, against the CLUSTER history ----
+r = ctx.sql(
+    "SELECT query_class, count(*), sum(cpu_seconds), sum(shuffle_bytes) "
+    "FROM system.queries GROUP BY query_class"
+).collect()
+d = r.to_pydict()
+assert r.num_rows == 2, d
+cpu_col = d[r.column_names[2]]
+assert all(c > 0 for c in cpu_col), d
+assert sum(d[r.column_names[3]]) > 0, d
+print("ACCEPTANCE-OK", d)
+
+# attempts + executors through SQL
+at = ctx.sql(
+    "SELECT state, cpu_seconds, wall_seconds FROM system.task_attempts"
+).collect().to_pydict()
+# every attempt consumed wall time; a trivial final-agg task can round
+# its CPU thread-time to zero — the SUM must still be real work
+assert len(at["state"]) >= 3, at
+assert all(w > 0 for w in at["wall_seconds"]), at
+assert sum(at["cpu_seconds"]) > 0, at
+ex = ctx.sql(
+    "SELECT id, alive, task_slots FROM system.executors"
+).collect().to_pydict()
+# slots follow effective_task_slots (device-capped on CPU) — just real
+assert ex["alive"] == [True] and ex["task_slots"][0] >= 1, ex
+print("SQL-TABLES-OK")
+
+sched = ctx._standalone_cluster.scheduler
+
+# -- REST: /api/history + timeline push counters + metrics ---------------
+httpd, port = start_rest_server(sched, "127.0.0.1", 0)
+try:
+    base = f"http://127.0.0.1:{port}"
+    hist = json.load(urllib.request.urlopen(base + "/api/history"))
+    assert hist["kind"] == "queries"
+    assert len(hist["rows"]) == 2
+    assert all(r["status"] == "completed" for r in hist["rows"])
+    assert all(r["cost"]["wall_seconds"] > 0 for r in hist["rows"])
+    att = json.load(urllib.request.urlopen(
+        base + "/api/history?kind=task_attempts&limit=2"
+    ))
+    assert len(att["rows"]) == 2
+    exr = json.load(urllib.request.urlopen(
+        base + "/api/history?kind=executors"
+    ))
+    assert len(exr["rows"]) == 1 and exr["rows"][0]["alive"]
+    import urllib.error
+    try:
+        urllib.request.urlopen(base + "/api/history?kind=nope")
+        raise SystemExit("expected 400 for unknown kind")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    # timeline rows carry the push data-plane counters (ISSUE 14
+    # satellite: PR 13's counters in the Gantt rows)
+    job_id = hist["rows"][0]["job_id"]
+    tl = json.load(urllib.request.urlopen(
+        base + f"/api/job/{job_id}/timeline"
+    ))
+    assert tl["tasks"], tl
+    for row in tl["tasks"]:
+        assert "pushed_bytes" in row and "push_spill_bytes" in row \
+            and "push_fallbacks" in row
+    assert sum(row["pushed_bytes"] for row in tl["tasks"]) > 0, tl
+    # the Prometheus cost rollup renders + validates
+    body = urllib.request.urlopen(base + "/api/metrics").read().decode()
+    from ballista_tpu.obs.prometheus import validate_exposition
+    validate_exposition(body)
+    assert 'ballista_job_cost_total{class=' in body, body[:2000]
+    assert 'resource="cpu_seconds"' in body
+    assert "ballista_history_jobs" in body
+finally:
+    stop_rest_server(httpd)
+print("REST-OK")
+
+# job detail carries the aggregated cost
+from ballista_tpu.scheduler.rest import job_detail
+det = job_detail(sched, job_id)
+assert det["cost"]["wall_seconds"] > 0, det["cost"]
+ctx.close()
+print("DISTRIBUTED-HISTORY-OK")
+"""
+
+
+def test_distributed_system_tables_rest_and_metrics():
+    proc = subprocess.run(
+        [sys.executable, "-c", _DISTRIBUTED_SCRIPT],
+        env=CPU_MESH_ENV,
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "DISTRIBUTED-HISTORY-OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# durability satellite: sqlite history survives a scheduler restart
+# ---------------------------------------------------------------------------
+
+
+def test_sqlite_history_survives_scheduler_restart(tmp_path):
+    script = rf"""
+import json
+import urllib.request
+
+import pyarrow as pa
+
+from ballista_tpu.client.context import BallistaContext
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.scheduler.rest import start_rest_server, stop_rest_server
+from ballista_tpu.scheduler.state_backend import SqliteBackend
+from ballista_tpu.standalone import StandaloneCluster
+
+path = {str(tmp_path / 'sched.db')!r}
+cfg = BallistaConfig().with_setting("ballista.shuffle.partitions", "2")
+
+cluster = StandaloneCluster.start(cfg, 4, state_backend=SqliteBackend(path))
+ctx = BallistaContext(f"localhost:{{cluster.scheduler_port}}", cfg)
+ctx._standalone_cluster = cluster
+cluster.attach_provider(ctx)
+t = pa.table({{
+    "k": pa.array(["a", "b", "a", "c"] * 50),
+    "v": pa.array(list(range(200)), type=pa.int64()),
+}})
+ctx.register_table("t1", t)
+ctx.sql("SELECT k, sum(v) AS s FROM t1 GROUP BY k").collect()
+before = cluster.scheduler.history.jobs()
+assert len(before) == 1 and before[0]["status"] == "completed"
+assert before[0]["cost"]["wall_seconds"] > 0
+old_class = before[0]["query_class"]
+old_job = before[0]["job_id"]
+ctx.close()
+
+# ---- restart: a brand-new cluster over the SAME sqlite file ----------
+cluster2 = StandaloneCluster.start(cfg, 4, state_backend=SqliteBackend(path))
+ctx2 = BallistaContext(f"localhost:{{cluster2.scheduler_port}}", cfg)
+ctx2._standalone_cluster = cluster2
+cluster2.attach_provider(ctx2)
+
+# /api/history re-serves the pre-restart record
+httpd, port = start_rest_server(cluster2.scheduler, "127.0.0.1", 0)
+try:
+    hist = json.load(urllib.request.urlopen(
+        f"http://127.0.0.1:{{port}}/api/history"
+    ))
+    by_id = {{r["job_id"]: r for r in hist["rows"]}}
+    assert old_job in by_id, (old_job, list(by_id))
+    assert by_id[old_job]["status"] == "completed"
+    assert by_id[old_job]["cost"]["wall_seconds"] > 0
+finally:
+    stop_rest_server(httpd)
+
+# system.queries re-serves it THROUGH the engine on the new cluster
+rows = ctx2.sql(
+    "SELECT job_id, query_class, status, wall_seconds "
+    "FROM system.queries"
+).collect().to_pydict()
+i = rows["job_id"].index(old_job)
+assert rows["status"][i] == "completed"
+assert rows["query_class"][i] == old_class
+assert rows["wall_seconds"][i] > 0
+ctx2.close()
+print("DURABILITY-OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        env=CPU_MESH_ENV,
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "DURABILITY-OK" in proc.stdout
