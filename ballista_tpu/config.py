@@ -94,6 +94,14 @@ BALLISTA_SKEW_MIN_ROWS = (
 BALLISTA_SCALER_QUEUE_WAIT_TARGET_S = (
     "ballista.tpu.scaler_queue_wait_target_s"  # KEDA pressure target
 )
+# adaptive query execution (docs/aqe.md)
+BALLISTA_AQE = "ballista.tpu.aqe"  # runtime re-planning policy
+BALLISTA_AQE_BROADCAST_THRESHOLD_MB = (
+    "ballista.tpu.aqe_broadcast_threshold_mb"  # small-build broadcast cutoff
+)
+BALLISTA_AQE_TARGET_PARTITION_MB = (
+    "ballista.tpu.aqe_target_partition_mb"  # coalesce-toward bucket size
+)
 # queryable history + cost accounting (docs/observability.md)
 BALLISTA_COST_ACCOUNTING = (
     "ballista.tpu.cost_accounting"  # per-attempt resource cost vectors
@@ -218,6 +226,14 @@ ENV_REGISTRY: tuple[EnvEntry, ...] = (
         "lineage recomputes, and certified rewrites must re-record "
         "identical hashes (analysis/replay.py)",
         "docs/fault_tolerance.md",
+    ),
+    EnvEntry(
+        "BALLISTA_AQE", "0|1", "",
+        "Process-wide adaptive-query-execution override: 0/off forces "
+        "the AQE policy off regardless of session config (the ops "
+        "kill-switch), 1/on forces it on; unset defers to "
+        "ballista.tpu.aqe",
+        "docs/aqe.md",
     ),
     EnvEntry(
         "BALLISTA_TPU_JAX_CACHE", "path|off", "~/.cache/ballista_tpu_jax",
@@ -758,6 +774,46 @@ def _entries() -> dict[str, ConfigEntry]:
             float,
         ),
         ConfigEntry(
+            BALLISTA_AQE,
+            "Adaptive query execution (docs/aqe.md): the scheduler's "
+            "runtime re-planning policy reads completed producers' "
+            "shuffle stats + the skew monitor at StageFinished, decides "
+            "which certified rewrite to apply (build-side flip, "
+            "small-side broadcast, coalesce/split of shuffle buckets), "
+            "applies every adaptation through "
+            "SchedulerServer.apply_certified_rewrite (a failing "
+            "certificate clause rejects it and the job proceeds on the "
+            "pristine plan), and persists learned per-query-class "
+            "strategies through the plan-hint seam so a fresh process "
+            "plans adaptively from submission. Off (default) records "
+            "and applies nothing. The BALLISTA_AQE env var overrides "
+            "this process-wide.",
+            "false",
+            _parse_bool,
+        ),
+        ConfigEntry(
+            BALLISTA_AQE_BROADCAST_THRESHOLD_MB,
+            "AQE broadcast cutoff (docs/aqe.md): a partitioned join "
+            "whose build side measured under this many MB of shuffle "
+            "output is re-planned as a collect (broadcast-build) join "
+            "on the next submission of its query class. <= 0 disables "
+            "the broadcast rule.",
+            "32",
+            int,
+        ),
+        ConfigEntry(
+            BALLISTA_AQE_TARGET_PARTITION_MB,
+            "AQE coalesce target (docs/aqe.md): when a consumer's "
+            "observed input buckets would all fit in fewer buckets of "
+            "this size, the bucket count is coalesced down to that "
+            "ideal on the next submission of its query class (fuller "
+            "buckets amortize per-task costs). Skewed inputs instead "
+            "split, governed by ballista.tpu.skew_ratio/skew_min_rows. "
+            "<= 0 disables the coalesce rule.",
+            "16",
+            int,
+        ),
+        ConfigEntry(
             BALLISTA_COST_ACCOUNTING,
             "Per-attempt resource cost accounting "
             "(docs/observability.md): executors measure a cost vector "
@@ -977,6 +1033,15 @@ class BallistaConfig:
 
     def scaler_queue_wait_target_s(self) -> float:
         return self._get(BALLISTA_SCALER_QUEUE_WAIT_TARGET_S)
+
+    def aqe(self) -> bool:
+        return self._get(BALLISTA_AQE)
+
+    def aqe_broadcast_threshold_mb(self) -> int:
+        return self._get(BALLISTA_AQE_BROADCAST_THRESHOLD_MB)
+
+    def aqe_target_partition_mb(self) -> int:
+        return self._get(BALLISTA_AQE_TARGET_PARTITION_MB)
 
     def cost_accounting(self) -> bool:
         return self._get(BALLISTA_COST_ACCOUNTING)

@@ -627,11 +627,17 @@ class TpuContext(Catalog, TableProvider):
                     os.environ["BALLISTA_TPU_NO_FUSE"] = prev_no_fuse
         elapsed = _time.perf_counter() - t0
         self._hints.save_if_changed(self._capacity_hint, self._plan_cache)
+        from ballista_tpu.scheduler.aqe import narrate as aqe_narrate
+
         rows = [
             ("physical_plan (analyzed)", profile.annotated_display(phys)),
             ("analyze_summary",
              f"total_elapsed={elapsed:.6f}s, fusion=off "
              "(per-operator attribution)"),
+            # AQE narration (docs/aqe.md): the distributed query class
+            # this statement maps to and the learned strategies a
+            # cluster submission would apply from planning time
+            ("aqe", aqe_narrate(self, optimized)),
         ]
         t = pa.table(
             {

@@ -940,6 +940,22 @@ class HashJoinExec(ExecutionPlan):
             return
         cache = ctx.plan_cache if ctx is not None else None
         key = ("join_lut", fp) if fp else None
+        if any(
+            bt.batch.schema.fields[i].dtype == DataType.STRING
+            for i in bt.key_idxs
+        ):
+            # dictionary-coded key domains GROW mid-task: every probe
+            # batch that unifies new strings into the build dictionary
+            # extends the code range, so a cached domain re-poisons the
+            # cache on every attempt — learn the first build's range,
+            # outgrow it on the next unification, invalidate, relearn —
+            # until the speculation-retry bound fails the task (observed
+            # when an AQE build-side flip promoted a dict-keyed build
+            # under a >LUT-threshold probe). Dict-keyed builds take the
+            # fresh-flags path on every (re)build instead: one memoized
+            # flags fetch per rebuild, and the attached domain is the
+            # build's true current one, so it can never go stale.
+            cache, key = None, None
         cached = cache.get(key) if (cache is not None and key) else None
         if cached == 0:  # learned: contiguous or domain too wide
             return

@@ -301,6 +301,8 @@ class HistoryStore:
         recomputes: int = 0,
         stragglers: int = 0,
         skew_partitions: int = 0,
+        aqe_applied: int = 0,
+        aqe_rejected: int = 0,
         error: str = "",
         cost: CostVector | None = None,
     ) -> None:
@@ -323,6 +325,11 @@ class HistoryStore:
             "recomputes": int(recomputes),
             "stragglers": int(stragglers),
             "skew_partitions": int(skew_partitions),
+            # AQE decision tally (docs/aqe.md): how many certified
+            # rewrites the policy applied/was denied on this job — the
+            # durable adaptation record beside latency and cost
+            "aqe_applied": int(aqe_applied),
+            "aqe_rejected": int(aqe_rejected),
             "error": error[:1024],
             "cost": (cost or CostVector()).to_dict(),
         }
@@ -508,6 +515,10 @@ QUERIES_SCHEMA = Schema(
         Field("recomputes", DataType.INT64),
         Field("stragglers", DataType.INT64),
         Field("skew_partitions", DataType.INT64),
+        # AQE adaptation tally (docs/aqe.md) — queryable like the other
+        # per-job counters: SELECT sum(aqe_applied) FROM system.queries
+        Field("aqe_applied", DataType.INT64),
+        Field("aqe_rejected", DataType.INT64),
         Field("error", DataType.STRING),
     ]
     + _COST_FIELDS

@@ -120,11 +120,13 @@ def scheduler_families(server) -> list[tuple]:
         jobs = list(server.jobs.values())
         task_counters = dict(server.obs_task_counters)
     status_counts: dict[str, int] = {}
-    retries = recomputes = 0
+    retries = recomputes = rewrites = rewrite_rejects = 0
     for j in jobs:
         status_counts[j.status] = status_counts.get(j.status, 0) + 1
         retries += j.total_retries
         recomputes += j.total_recomputes
+        rewrites += j.total_rewrites
+        rewrite_rejects += j.total_rewrite_rejects
     free = total = alive = devices = 0
     compile_samples: list[tuple] = []
     alive_ids = em.get_alive_executors(server.executor_timeout_s)
@@ -155,6 +157,15 @@ def scheduler_families(server) -> list[tuple]:
          "Bounded task retries across all jobs", [({}, retries)]),
         ("ballista_recomputes_total", "counter",
          "Lost-shuffle recompute rounds across all jobs", [({}, recomputes)]),
+        # certified-rewrite visibility (docs/aqe.md): until now these
+        # existed only as REST state fields — Prometheus gets the same
+        # accepted/rejected totals, plus the per-op AQE family below
+        ("ballista_plan_rewrites_total", "counter",
+         "Certified plan rewrites ACCEPTED across all jobs "
+         "(apply_certified_rewrite — AQE and manual)", [({}, rewrites)]),
+        ("ballista_plan_rewrite_rejects_total", "counter",
+         "Certified plan rewrites REJECTED by certificate validation "
+         "across all jobs", [({}, rewrite_rejects)]),
         ("ballista_event_queue_depth", "gauge",
          "Scheduler event-loop queue depth (bounded queue + overflow)",
          [({}, server.event_loop.depth())]),
@@ -188,6 +199,20 @@ def scheduler_families(server) -> list[tuple]:
          "Tasks flagged by the per-stage straggler monitor "
          "(duration > straggler_factor x stage median)",
          [({"class": c}, n) for c, n in sorted(stragglers.items())]
+         or [({}, 0)])
+    )
+    # AQE policy decisions by op kind and outcome (docs/aqe.md):
+    # applied = certified rewrite accepted, rejected = certificate
+    # clause failed (the job ran on the pristine template), learned =
+    # strategy recorded for the class's next submission
+    with server._lock:
+        aqe_totals = dict(server.obs_aqe_total)
+    families.append(
+        ("ballista_aqe_rewrites_total", "counter",
+         "AQE policy decisions by rewrite op and outcome "
+         "(applied|rejected|learned — docs/aqe.md)",
+         [({"op": op, "outcome": outcome}, n)
+          for (op, outcome), n in sorted(aqe_totals.items())]
          or [({}, 0)])
     )
     families.append(

@@ -295,6 +295,13 @@ def job_detail(server, job_id: str) -> dict | None:
             # template swaps + certificate rejections
             "rewrites": job.total_rewrites,
             "rewrite_rejects": job.total_rewrite_rejects,
+            # per-rewrite decision log (docs/aqe.md): op, touched stage
+            # ids, outcome, and the failing certificate clause on a
+            # reject — the "why did this stage change shape" answer
+            "rewrite_log": [dict(r) for r in job.rewrite_log],
+            # AQE policy decisions layered over the rewrites: source
+            # (reactive/learned) + before/after stats per decision
+            "aqe": [dict(d) for d in job.aqe_decisions],
             "trace_id": job.trace_id,
             # fleet observability (docs/observability.md): the label
             # every latency series for this job aggregates under, plus
@@ -338,6 +345,10 @@ def job_timeline(server, job_id: str) -> dict | None:
         if job is None:
             return None
         skew = set(job.skew_flags)
+        # stages whose template an accepted certified rewrite swapped
+        # (docs/aqe.md): the Gantt view marks their rows so a mid-job
+        # partition-count change is explained, not mysterious
+        rewritten = set(job.rewritten_stages)
         # push-shuffle data-plane counters per (stage, partition) from
         # the shipped per-operator metrics (docs/shuffle.md): how many
         # bytes each task committed in memory, spilled under window
@@ -403,6 +414,9 @@ def job_timeline(server, job_id: str) -> dict | None:
                     "duration_s": round(max(0.0, dur), 6),
                     "straggler": straggler,
                     "skewed": (st["stage_id"], t["partition"]) in skew,
+                    # this stage's template was swapped by an accepted
+                    # certified rewrite (AQE or manual) — docs/aqe.md
+                    "rewritten": st["stage_id"] in rewritten,
                     # push data-plane visibility (docs/shuffle.md)
                     "pushed_bytes": push["pushed_bytes"],
                     "push_spill_bytes": push["push_spill_bytes"],

@@ -140,6 +140,18 @@ class JobInfo:
     # REST and the chaos suites; both 0 on a non-adaptive run)
     total_rewrites: int = 0
     total_rewrite_rejects: int = 0
+    # per-rewrite decision log (docs/aqe.md): one dict per
+    # apply_certified_rewrite call — op, stage ids, outcome, and the
+    # failing certificate clause on a reject — served by /api/job/<id>
+    # so the UI can explain WHY a stage's shape changed mid-job
+    rewrite_log: list = dataclasses.field(default_factory=list)
+    # stage ids touched by ACCEPTED rewrites (the /timeline "rewritten"
+    # marker: a Gantt row whose partition count changed mid-job says so)
+    rewritten_stages: set = dataclasses.field(default_factory=set)
+    # AQE policy decisions (scheduler/aqe.py): applied/rejected/learned,
+    # with before/after stats — the policy-level view layered over
+    # rewrite_log
+    aqe_decisions: list = dataclasses.field(default_factory=list)
     # observability (docs/observability.md). trace_id is minted at
     # submission when the session's ballista.tpu.trace is not "off";
     # empty trace_id IS the zero-overhead off path (no span is ever
@@ -364,6 +376,15 @@ class SchedulerServer:
             namespace,
             retention_jobs=self.config.history_retention_jobs(),
         )
+        # adaptive query execution (docs/aqe.md): the policy engine that
+        # reads runtime stats and applies certified rewrites; inert
+        # unless the session's ballista.tpu.aqe (or BALLISTA_AQE) turns
+        # it on. The counter map feeds
+        # ballista_aqe_rewrites_total{op,outcome} on /api/metrics.
+        from ballista_tpu.scheduler.aqe import AqePolicy
+
+        self.aqe = AqePolicy(self)
+        self.obs_aqe_total: dict[tuple[str, str], int] = {}
         self.state = None
         if state_backend is not None:
             from ballista_tpu.scheduler.persistent_state import (
@@ -880,6 +901,13 @@ class SchedulerServer:
         with self._lock:
             cost = job.cost
             skew = len(job.skew_flags)
+            aqe_applied = sum(
+                1 for d in job.aqe_decisions if d.get("outcome") == "applied"
+            )
+            aqe_rejected = sum(
+                1 for d in job.aqe_decisions
+                if d.get("outcome") == "rejected"
+            )
         self.history.record_terminal(
             job.job_id,
             status,
@@ -892,6 +920,8 @@ class SchedulerServer:
             recomputes=job.total_recomputes,
             stragglers=stragglers,
             skew_partitions=skew,
+            aqe_applied=aqe_applied,
+            aqe_rejected=aqe_rejected,
             error=job.error,
             cost=cost,
         )
@@ -1054,28 +1084,132 @@ class SchedulerServer:
             rows = rows_by_part[part]
             if rows < floor or rows <= ratio * med:
                 continue
-            with self._lock:
-                if (stage_id, part) in job.skew_flags:
-                    continue
-                job.skew_flags.append((stage_id, part))
-                self.obs_skew_total[job.query_class] = (
-                    self.obs_skew_total.get(job.query_class, 0) + 1
-                )
-            self._job_event(
-                job, "skew",
-                parent_id=self._stage_span_id(job, stage_id),
-                attrs={
-                    "stage_id": stage_id,
-                    "partition": part,
-                    "rows": int(rows),
-                    "stage_median_rows": int(med),
-                },
+            self._commit_skew_flag(
+                job, stage_id, part, rows, med, ratio, source="output"
             )
-            log.warning(
-                "skew: partition %s/%s/%s processed %d rows "
-                "(stage median %d, ratio %.1f)",
-                job.job_id, stage_id, part, int(rows), int(med), ratio,
+
+    def _commit_skew_flag(
+        self,
+        job: JobInfo,
+        stage_id: int,
+        part: int,
+        rows: float,
+        med: float,
+        ratio: float,
+        source: str,
+    ) -> None:
+        """The ONE skew-commit protocol shared by the post-run
+        output-rows pass (``_detect_skew``) and the pre-run input-bucket
+        pass (``_detect_input_skew``): dedup'd flag, counter, trace
+        event, warning — two hand-synced copies would drift, and both
+        passes feed the same consumers (timeline ``skewed`` bit, the
+        AQE split rule)."""
+        with self._lock:
+            if (stage_id, part) in job.skew_flags:
+                return
+            job.skew_flags.append((stage_id, part))
+            self.obs_skew_total[job.query_class] = (
+                self.obs_skew_total.get(job.query_class, 0) + 1
             )
+        attrs = {
+            "stage_id": stage_id,
+            "partition": part,
+            "rows": int(rows),
+            "stage_median_rows": int(med),
+        }
+        if source != "output":
+            # distinguishes the pre-run input-bucket flag from the
+            # post-run output-rows flag (regression-tested)
+            attrs["source"] = source
+        self._job_event(
+            job, "skew",
+            parent_id=self._stage_span_id(job, stage_id),
+            attrs=attrs,
+        )
+        log.warning(
+            "skew (%s): partition %s/%s/%s carries %d rows "
+            "(stage median %d, ratio %.1f)",
+            source, job.job_id, stage_id, part, int(rows), int(med),
+            ratio,
+        )
+
+    def _detect_input_skew(
+        self, job: JobInfo, consumer_id: int, stats: dict
+    ) -> None:
+        """Input-bucket skew for a consumer whose producers just ALL
+        completed (docs/aqe.md): the producers' committed shuffle-write
+        metas give exact per-bucket rows BEFORE the consumer runs, so
+        the flag — and the AQE split policy reading it — arrives in
+        time to act. This is the timing fix for the final stage too:
+        its own ``_detect_skew`` pass used to run only at job
+        completion, after anything could be done about it; evaluating
+        its producers at the last StageFinished closes that gap. Flags
+        share the (stage, partition) key space with ``_detect_skew``
+        (a consumer task ``p`` reads exactly input bucket ``p``), so
+        the later output-rows pass dedups against these."""
+        cfg = self._session_config(job.session_id)
+        ratio = cfg.skew_ratio()
+        if ratio <= 0:
+            return
+        from ballista_tpu.scheduler.aqe import keyed_bucket_totals
+
+        with self._lock:
+            stage = job.stages.get(consumer_id)
+            n_buckets = (
+                stage.input_partition_count if stage is not None else 0
+            )
+        if n_buckets < 2:
+            return
+        with self._lock:
+            buckets, keyed = keyed_bucket_totals(job, stats)
+        if not keyed:
+            return
+        rows_by_bucket = {
+            b: buckets.get(b, (0, 0))[0] for b in range(n_buckets)
+        }
+        import statistics
+
+        med = statistics.median(rows_by_bucket.values())
+        if med <= 0:
+            return
+        floor = cfg.skew_min_rows()
+        for part in sorted(rows_by_bucket):
+            rows = rows_by_bucket[part]
+            if rows < floor or rows <= ratio * med:
+                continue
+            self._commit_skew_flag(
+                job, consumer_id, part, rows, med, ratio, source="input"
+            )
+
+    def record_aqe_decision(self, job: JobInfo, decision: dict) -> None:
+        """One AQE policy decision (docs/aqe.md): appended to the job's
+        decision log (REST /api/job), counted into the
+        ballista_aqe_rewrites_total{op,outcome} family, and recorded as
+        an ``aqe`` trace event carrying the before/after stats."""
+        key = (decision.get("op", "?"), decision.get("outcome", "?"))
+        with self._lock:
+            if len(job.aqe_decisions) < 256:
+                job.aqe_decisions.append(dict(decision))
+            self.obs_aqe_total[key] = self.obs_aqe_total.get(key, 0) + 1
+        attrs = {
+            "op": decision.get("op", ""),
+            "outcome": decision.get("outcome", ""),
+            "stage_ids": decision.get("stage_ids", []),
+            "source": decision.get("source", ""),
+        }
+        if decision.get("clause"):
+            attrs["clause"] = decision["clause"]
+        for side in ("before", "after"):
+            for k, v in sorted((decision.get(side) or {}).items()):
+                attrs[f"{side}_{k}"] = v
+        self._job_event(job, "aqe", attrs=attrs)
+        log.info(
+            "aqe %s: %s %s stages=%s%s", decision.get("outcome"),
+            decision.get("op"), decision.get("source", ""),
+            decision.get("stage_ids"),
+            f" clause={decision['clause']}" if decision.get("clause")
+            else "",
+        )
 
     def desired_executors(self) -> int:
         """The composite autoscale pressure the KEDA ExternalScaler
@@ -1195,12 +1329,66 @@ class SchedulerServer:
                     job_id, stage.stage_id, stage.plan
                 )
             self.state.save_job(job)
-        self._submit_stage(job_id, job.final_stage_id, set())
+        # AQE proactive pass (docs/aqe.md): apply this query class's
+        # LEARNED strategies while every stage is still fully pending —
+        # the window where broadcast/coalesce/split (which re-bucket
+        # producers) are acceptable. When strategies exist, the leaf
+        # stages are submitted PENDING (not claimable) first: a pull
+        # executor's PollWork thread could otherwise claim a leaf task
+        # in the gap between submission and rewrite application and
+        # close the window with a spurious runtime-state rejection.
+        # The rewrites apply, then the dep-free stages promote below.
+        defer_running = False
+        try:
+            defer_running = self.aqe.wants_to_adapt(job)
+        except Exception:  # noqa: BLE001
+            log.exception("AQE strategy lookup failed for %s", job_id)
+        self._submit_stage(
+            job_id, job.final_stage_id, set(), defer_running=defer_running
+        )
+        if defer_running:
+            try:
+                self.aqe.on_job_submitted(job)
+            except Exception:  # noqa: BLE001 — adaptation must never
+                # outrank the submission it advises
+                log.exception("AQE submission policy failed for %s", job_id)
+            # open the gates: promote every pending stage whose deps are
+            # already complete (leaf stages; apply_certified_rewrite has
+            # already re-promoted the ones it touched)
+            deferred: list = []
+            with self._lock:
+                for sid in sorted(job.stages):
+                    if not self.stage_manager.is_pending_stage(job_id, sid):
+                        continue
+                    if any(
+                        not self.stage_manager.is_completed_stage(
+                            job_id, u.stage_id
+                        )
+                        for u in find_unresolved_shuffles(
+                            job.stages[sid].plan
+                        )
+                    ):
+                        continue
+                    self._resolve_stage(job_id, sid)
+                    deferred.extend(
+                        self.stage_manager.promote_pending_stage(
+                            job_id, sid
+                        )
+                    )
+            for e in deferred:
+                self.event_loop.post(e)
 
     def _submit_stage(
-        self, job_id: str, stage_id: int, seen: set[int]
+        self,
+        job_id: str,
+        stage_id: int,
+        seen: set[int],
+        defer_running: bool = False,
     ) -> None:
-        """Recursive dependency walk (ref :124-177)."""
+        """Recursive dependency walk (ref :124-177). ``defer_running``
+        registers even dependency-free stages as PENDING (nothing is
+        claimable yet): the AQE submission pass rewrites templates
+        first, then the caller promotes — see ``_generate_stages``."""
         if stage_id in seen:
             return
         seen.add(stage_id)
@@ -1225,7 +1413,13 @@ class SchedulerServer:
                 job_id, stage_id, n_tasks, max_attempts=job.max_attempts
             )
             for u in unfinished:
-                self._submit_stage(job_id, u.stage_id, seen)
+                self._submit_stage(
+                    job_id, u.stage_id, seen, defer_running=defer_running
+                )
+        elif defer_running:
+            self.stage_manager.add_pending_stage(
+                job_id, stage_id, n_tasks, max_attempts=job.max_attempts
+            )
         else:
             self._resolve_stage(job_id, stage_id)
             self.stage_manager.add_running_stage(
@@ -1311,6 +1505,58 @@ class SchedulerServer:
         # has reported — its shipped per-partition metrics are complete,
         # so the rows-vs-median comparison is meaningful exactly now
         self._detect_skew(job, stage_id)
+        # consumers whose producers are ALL now complete: the stages the
+        # promote loop below is about to start. Their input-bucket skew
+        # is knowable exactly now (producer metas are final), and this
+        # is the AQE policy's decision point — BEFORE promotion, while
+        # the consumer is still fully pending and a certified rewrite of
+        # it can still be accepted (docs/aqe.md).
+        ready: list[int] = []
+        # the stats pass below scans every completed producer's shuffle
+        # metas — skip it entirely when neither consumer exists: the
+        # skew monitor is off AND the AQE policy is disabled (the
+        # common aqe=false default must not pay for adaptivity)
+        from ballista_tpu.scheduler import aqe as aqe_mod
+
+        cfg = self._session_config(job.session_id)
+        want_stats = cfg.skew_ratio() > 0 or aqe_mod.enabled(cfg)
+        if want_stats:
+            with self._lock:
+                for parent in sorted(
+                    self.stage_manager.parents_of(job_id, stage_id)
+                ):
+                    if not self.stage_manager.is_pending_stage(
+                        job_id, parent
+                    ):
+                        continue
+                    stage = job.stages.get(parent)
+                    if stage is not None and all(
+                        self.stage_manager.is_completed_stage(
+                            job_id, u.stage_id
+                        )
+                        for u in find_unresolved_shuffles(stage.plan)
+                    ):
+                        ready.append(parent)
+        # producer stats computed ONCE per ready consumer (full scans of
+        # the completed shuffle metas) and shared by the skew pass and
+        # the policy — this runs on the event-loop thread, and doubling
+        # the scan would show up straight in the dispatch-lag histogram
+        ready_stats: dict[int, dict] = {}
+        from ballista_tpu.scheduler.aqe import producer_stats
+
+        for parent in ready:
+            with self._lock:
+                stage = job.stages.get(parent)
+                plan = stage.plan if stage is not None else None
+            if plan is None:
+                continue
+            ready_stats[parent] = producer_stats(self, job_id, plan)
+            self._detect_input_skew(job, parent, ready_stats[parent])
+        try:
+            self.aqe.on_stage_finished(job, stage_id, ready_stats)
+        except Exception:  # noqa: BLE001 — adaptation must never outrank
+            # the promotion it advises; the job proceeds unadapted
+            log.exception("AQE StageFinished policy failed for %s", job_id)
         deferred: list = []
         promoted: list[int] = []
         # sorted: parents_of returns a set, and promote/event order should
@@ -1520,6 +1766,21 @@ class SchedulerServer:
                     job.resolved_plan_bytes.pop(sid, None)
                     job.eager_plan_bytes.pop(sid, None)
                 job.total_rewrites += 1
+                # rewrite visibility (docs/aqe.md): the decision log
+                # /api/job serves + the /timeline "rewritten" stage
+                # marker (why did this stage's partition count change?)
+                job.rewritten_stages.update(touched)
+                if len(job.rewrite_log) < 256:
+                    job.rewrite_log.append(
+                        {
+                            "op": op.describe(),
+                            "outcome": "applied",
+                            "exactness": cert.exactness,
+                            "rewritten": sorted(cert.rewritten_stages),
+                            "added": sorted(cert.added_stages),
+                            "removed": sorted(cert.removed_stages),
+                        }
+                    )
                 from ballista_tpu import rewrite as _rw
                 from ballista_tpu.analysis import replay
 
@@ -1576,6 +1837,17 @@ class SchedulerServer:
                 # chaos assertions read these, and an unlocked
                 # read-modify-write can drop concurrent increments
                 job.total_rewrite_rejects += 1
+                if len(job.rewrite_log) < 256:
+                    job.rewrite_log.append(
+                        {
+                            "op": op.describe(),
+                            "outcome": "rejected",
+                            "clause": e.clause,
+                            "stage_ids": sorted(
+                                int(s) for s in (e.stage_ids or ())
+                            ),
+                        }
+                    )
             self._job_event(
                 job, "rewrite_reject",
                 attrs={"op": op.describe(), "clause": e.clause},
@@ -1643,6 +1915,10 @@ class SchedulerServer:
                 old.stage_spans.clear()
                 old.stage_stats = None
                 old.root_span = None
+                # decision logs follow the same retention discipline as
+                # the other heavy per-job payloads (counters stay)
+                old.rewrite_log.clear()
+                old.aqe_decisions.clear()
                 if old.trace_id:
                     self._traces.pop(old.trace_id, None)
 
@@ -1673,6 +1949,14 @@ class SchedulerServer:
             )
         if self.state is not None:
             self.state.save_job(job)
+        # AQE learning that needs the full run's per-operator metrics
+        # (inline-probe collect joins can only be sized post-hoc) —
+        # BEFORE the trace closes so its decisions land in the span tree
+        try:
+            self.aqe.on_job_finished(job)
+        except Exception:  # noqa: BLE001 — learning must never outrank
+            # job completion
+            log.exception("AQE completion policy failed for %s", job_id)
         # observability: stats + trace snapshot BEFORE the stage teardown
         # below — /api/job/<id> keeps serving the run's per-stage/
         # per-partition stats after completion (docs/observability.md)

@@ -731,6 +731,18 @@ class StageManager:
             t.straggler = True
             return True
 
+    def all_tasks_pending(self, job_id: str, stage_id: int) -> bool:
+        """True when every task of the stage is PENDING — the rewrite
+        window (rebind_stages_for_rewrite's precondition). Eager-shuffle
+        handout can start a PENDING stage's tasks early, which closes
+        the window without promoting the stage; the AQE policy checks
+        here before proposing a mid-job rewrite (docs/aqe.md)."""
+        with self._lock:
+            stage = self._stages.get((job_id, stage_id))
+            if stage is None:
+                return False
+            return all(t.state == TaskState.PENDING for t in stage.tasks)
+
     def stage_recomputes(self, job_id: str, stage_id: int) -> int:
         with self._lock:
             stage = self._stages.get((job_id, stage_id))

@@ -25,6 +25,9 @@ prefetch paths and row-group pruning are exercised — the SF>=10
 out-of-core configurations. BENCH_STREAM_SLICE_MB shrinks the streamed
 slice (default 1GB) and BENCH_ROW_GROUP_ROWS the written row groups
 (default 1M rows) so the prefetch A/B also runs at small SF.
+BENCH_AQE=1 runs the adaptive-query-execution suite (docs/aqe.md):
+adaptive-vs-static on seeded skewed/misestimated data plus a TPC-H
+warm guardrail, writing BENCH_AQE.json.
 Details land in BENCH_DETAIL.json (SF=1) or
 BENCH_SF<SF>_DETAIL.json, with peak host RSS, per-query spill bytes /
 passes, and — when a query streamed — a prefetch-disabled A/B warm
@@ -1243,6 +1246,380 @@ def run_slo_suite() -> dict:
     return out
 
 
+def _aqe_tables(seed: int, n_fact: int, n_dim: int, n_keys: int) -> dict:
+    """The seeded skewed/misestimated dataset (docs/aqe.md): Zipfian
+    int keys (a hot-key groupby), string join keys (forcing the
+    collect-mode join whose build side the query ORDER mis-places), a
+    multi-hot-key int column (splittable skew — no single irreducible
+    key), and two small dimensions (one string-keyed for the wrong-side
+    build, one int-keyed for the broadcast rule)."""
+    import numpy as np
+    import pyarrow as pa
+
+    rng = np.random.default_rng(seed)
+    # Zipf ranks clipped to the key domain: rank 1 dominates (the
+    # classic hot-key groupby), the tail is long
+    ranks = rng.zipf(1.5, size=n_fact)
+    key = np.minimum(ranks, n_keys).astype(np.int64)
+    # moderate single-hot skew for the SPLIT arm: one key carries 15%
+    # of the mass, the rest uniform — at 16 buckets the hot bucket trips
+    # the skew ratio, and a split genuinely shrinks it (the hot key
+    # keeps its 15%, but the uniform freight sharing its bucket spreads)
+    hkey = np.where(
+        rng.random(n_fact) < 0.15,
+        np.int64(0),
+        rng.integers(1, 1000, n_fact),
+    ).astype(np.int64)
+    skey = pa.array([f"s{int(k) % (n_dim * 20)}" for k in key])
+    fact = pa.table(
+        {
+            "key": pa.array(key),
+            "hkey": pa.array(hkey),
+            "ikey": pa.array(
+                rng.integers(0, n_dim, n_fact).astype(np.int64)
+            ),
+            "skey": skey,
+            "v": pa.array(rng.uniform(0, 100, n_fact)),
+        }
+    )
+    dim = pa.table(
+        {
+            "skey": pa.array([f"s{i}" for i in range(n_dim)]),
+            "attr": pa.array((np.arange(n_dim) % 25).astype(np.int64)),
+        }
+    )
+    dim2 = pa.table(
+        {
+            "ikey": pa.array(np.arange(n_dim, dtype=np.int64)),
+            "iattr": pa.array((np.arange(n_dim) % 25).astype(np.int64)),
+        }
+    )
+    hdim = pa.table(
+        {
+            "hkey": pa.array(np.arange(1000, dtype=np.int64)),
+            "hattr": pa.array((np.arange(1000) % 25).astype(np.int64)),
+        }
+    )
+    return {"fact": fact, "dim": dim, "dim2": dim2, "hdim": hdim}
+
+
+# the AQE workload (docs/aqe.md): each query provokes one policy rule
+_AQE_QUERIES = {
+    # wrong-side build (dim JOIN fact puts the 2M-row fact on the build
+    # side of the string-keyed collect join) + Zipf groupby -> FLIP (and
+    # a coalesce of the tiny agg buckets rides along)
+    "skewed_join": (
+        "SELECT f.key, count(*) AS c, sum(f.v) AS s "
+        "FROM dim d JOIN fact f ON d.skey = f.skey "
+        "GROUP BY f.key ORDER BY s DESC LIMIT 100"
+    ),
+    # int-keyed partitioned join against a small dimension -> BROADCAST
+    "broadcast_join": (
+        "SELECT d2.iattr, count(*) AS c, sum(f.v) AS s "
+        "FROM fact f JOIN dim2 d2 ON f.ikey = d2.ikey "
+        "GROUP BY d2.iattr ORDER BY d2.iattr"
+    ),
+    # over-partitioned tiny aggregation -> COALESCE toward
+    # aqe_target_partition_mb (ikey tiebreak keeps the LIMIT
+    # deterministic across plans — counts tie)
+    "tiny_parts": (
+        "SELECT f.ikey, count(*) AS c, sum(f.v) AS s "
+        "FROM fact f GROUP BY f.ikey ORDER BY c DESC, f.ikey LIMIT 20"
+    ),
+}
+
+# the SPLIT arm runs in its own group: a hot-bucket ratio over the
+# median is structurally unreachable at the default 4 buckets (a
+# 4-sample median tracks the peak), so this group plans at 16 buckets
+# with the broadcast rule silenced to isolate the split behavior
+_AQE_SPLIT_QUERIES = {
+    "skew_split": (
+        "SELECT h.hattr, count(*) AS c, sum(f.v) AS s "
+        "FROM fact f JOIN hdim h ON f.hkey = h.hkey "
+        "GROUP BY h.hattr ORDER BY h.hattr"
+    ),
+}
+
+
+def run_aqe_suite() -> dict:
+    """BENCH_AQE=1: adaptive-vs-static on seeded skewed/misestimated
+    data (docs/aqe.md). Two arms on identical 2-executor standalone
+    clusters over the same seeded dataset:
+
+    - **static** — ``ballista.tpu.aqe=false``: one warmup pass
+      (compile caches), then ITERS measured warm passes.
+    - **adaptive** — ``ballista.tpu.aqe=true`` with a FRESH strategy
+      store: pass 1 observes and learns (its decisions are recorded as
+      the learning trace), then ITERS measured warm passes that apply
+      the learned strategies from submission — the fresh-process
+      adaptive-planning story, measured.
+
+    Per query the artifact records static/adaptive wall times, the
+    speedup, per-outcome adaptation counts (applied/rejected/learned/
+    reverted by op), and an arm-parity check (multiset-exact: float
+    aggregates compare to 1e-9 relative — the certificate class).
+    A TPC-H q1/q3/q5/q6/q18 warm guardrail (AQE on vs off) rides along:
+    well-estimated plans must not regress.
+
+    Env: BENCH_AQE_SEED (7), BENCH_AQE_FACT_ROWS (1.5M),
+    BENCH_AQE_TPCH_SF (0.05), BENCH_ITERS. Writes BENCH_AQE.json.
+    """
+    import numpy as np  # noqa: F401 — dataset gen
+    import pandas as pd
+
+    from ballista_tpu.client.context import BallistaContext
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.scheduler import aqe as aqe_mod
+    from ballista_tpu.tpch import gen_all
+
+    seed = int(os.environ.get("BENCH_AQE_SEED", "7"))
+    n_fact = int(os.environ.get("BENCH_AQE_FACT_ROWS", "1500000"))
+    tpch_sf = float(os.environ.get("BENCH_AQE_TPCH_SF", "0.05"))
+    iters = max(2, ITERS)
+    # hermetic strategy persistence: without this the suite would read
+    # AND write the developer's real plan_hints.json — arms would
+    # inherit each other's (and previous runs') learned strategies, and
+    # bench-learned strategies for real TPC-H classes would silently
+    # change later AQE-on runs in this environment
+    import tempfile
+
+    hint_dir = tempfile.mkdtemp(prefix="bench_aqe_hints_")
+    prev_hint = os.environ.get("BALLISTA_TPU_HINT_CACHE")
+    os.environ["BALLISTA_TPU_HINT_CACHE"] = hint_dir
+    try:
+        return _run_aqe_suite_hermetic(
+            seed, n_fact, tpch_sf, iters, hint_dir
+        )
+    finally:
+        # the env override must not outlive the suite even on an error
+        # path — anything the process does afterward would otherwise
+        # persist its real hints into the throwaway temp dir
+        if prev_hint is None:
+            os.environ.pop("BALLISTA_TPU_HINT_CACHE", None)
+        else:
+            os.environ["BALLISTA_TPU_HINT_CACHE"] = prev_hint
+
+
+def _run_aqe_suite_hermetic(
+    seed: int, n_fact: int, tpch_sf: float, iters: int, hint_dir: str
+) -> dict:
+    import tempfile
+
+    import pandas as pd
+
+    from ballista_tpu.client.context import BallistaContext
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.scheduler import aqe as aqe_mod
+    from ballista_tpu.tpch import gen_all
+
+    tables = _aqe_tables(seed, n_fact, n_dim=2000, n_keys=50000)
+
+    def make_cfg(aqe_on: bool, extra: dict | None = None) -> BallistaConfig:
+        cfg = (
+            BallistaConfig()
+            .with_setting("ballista.shuffle.partitions", "4")
+            # shared with the skew monitor; 2 keeps the split rule
+            # meaningful at moderate bucket counts (max > 4 x median is
+            # nearly unreachable at small n)
+            .with_setting("ballista.tpu.skew_ratio", "2")
+            .with_setting(
+                "ballista.tpu.aqe", "true" if aqe_on else "false"
+            )
+        )
+        for k, v in (extra or {}).items():
+            cfg = cfg.with_setting(k, v)
+        for kv in os.environ.get("BENCH_CONFIG", "").split(","):
+            if kv.strip():
+                k, v = kv.split("=", 1)
+                cfg = cfg.with_setting(k.strip(), v.strip())
+        return cfg
+
+    def outcome_counts(jobs) -> dict:
+        agg: dict = {}
+        for j in jobs:
+            for d in j.aqe_decisions:
+                agg.setdefault(d["outcome"], {})
+                agg[d["outcome"]][d["op"]] = (
+                    agg[d["outcome"]].get(d["op"], 0) + 1
+                )
+        return agg
+
+    def run_arm(
+        aqe_on: bool, queries: dict, data: dict, extra: dict | None = None
+    ) -> dict:
+        """One cluster, all queries: two warmup passes (for the adaptive
+        arm: the learning pass, then the FIRST adapted pass — which pays
+        the rewritten shapes' compiles exactly once), then measured warm
+        passes. Both arms warm up twice so the comparison is steady
+        state vs steady state. Returns per-query timings + decisions +
+        results + the measured passes' retrace count (must be 0: an
+        adapted query re-submitted must hit the closed compile
+        vocabulary, never re-trace)."""
+        from ballista_tpu.compilecache import metrics as cc_metrics
+
+        # fresh persistence root per ARM (static ones too): the
+        # in-memory store reset alone would reload a previous arm's
+        # strategies from a shared hint file, and static arms must not
+        # inherit an adaptive arm's executor plan hints either — every
+        # arm starts from the same blank-hint state
+        os.environ["BALLISTA_TPU_HINT_CACHE"] = tempfile.mkdtemp(
+            dir=hint_dir
+        )
+        if aqe_on:
+            aqe_mod.reset_store()
+        ctx = BallistaContext.standalone(
+            make_cfg(aqe_on, extra), n_executors=2
+        )
+        sched = ctx._standalone_cluster.scheduler
+        # the adaptation tally below reads job.aqe_decisions after every
+        # pass completed; the default obs-retention window (50 terminal
+        # jobs) strips decision logs, which would silently zero the
+        # counts at higher BENCH_ITERS
+        sched.obs_retained_jobs = 100_000
+        arm: dict = {}
+        try:
+            for name, t in data.items():
+                ctx.register_table(name, t)
+            for qn, sql in queries.items():
+                jobs_of_q = []
+
+                def one_pass():
+                    t0 = time.perf_counter()
+                    res = ctx.sql(sql).collect()
+                    dt = time.perf_counter() - t0
+                    with sched._lock:
+                        job = max(
+                            sched.jobs.values(),
+                            key=lambda j: j.submitted_s,
+                        )
+                    jobs_of_q.append(job)
+                    return dt, res
+                learn_s, result = one_pass()
+                # adaptive convergence: applying pass 1's strategies
+                # re-shapes the plan, which can expose NEW signals
+                # (different stages become observable) — keep passing
+                # until the class's strategy set stops changing, so the
+                # measured passes replay ONE stable adapted plan (and
+                # its compiles happened in the convergence passes).
+                # Static arms get the matching second warmup.
+                adapted_first_s, result = one_pass()
+                prev_specs = None
+                for _ in range(5 if aqe_on else 0):
+                    with sched._lock:
+                        job = max(
+                            sched.jobs.values(),
+                            key=lambda j: j.submitted_s,
+                        )
+                    specs = aqe_mod.strategy_store().get(job.query_class)
+                    if specs == prev_specs:
+                        break
+                    prev_specs = specs
+                    _, result = one_pass()
+                t_before = cc_metrics.snapshot().get("traces", 0)
+                times = []
+                for _ in range(iters):
+                    dt, result = one_pass()
+                    times.append(dt)
+                retraces = cc_metrics.snapshot().get("traces", 0) - t_before
+                arm[qn] = {
+                    "first_pass_s": round(learn_s, 4),
+                    "adapted_first_pass_s": round(adapted_first_s, 4),
+                    "warm_s": round(sum(times) / len(times), 4),
+                    "warm_best_s": round(min(times), 4),
+                    "warm_retraces": int(retraces),
+                    "adaptations": outcome_counts(jobs_of_q),
+                    "rewrites_last_run": jobs_of_q[-1].total_rewrites,
+                    "skew_flags_last_run": len(jobs_of_q[-1].skew_flags),
+                    "_result": result.to_pandas(),
+                }
+        finally:
+            ctx.close()
+        return arm
+
+    out: dict = {
+        "seed": seed,
+        "fact_rows": n_fact,
+        "iters": iters,
+        "queries": {},
+    }
+    split_extra = {
+        "ballista.shuffle.partitions": "16",
+        "ballista.tpu.aqe_broadcast_threshold_mb": "0",
+    }
+    static = run_arm(False, _AQE_QUERIES, tables)
+    static.update(run_arm(False, _AQE_SPLIT_QUERIES, tables, split_extra))
+    adaptive = run_arm(True, _AQE_QUERIES, tables)
+    adaptive.update(run_arm(True, _AQE_SPLIT_QUERIES, tables, split_extra))
+    for qn in list(_AQE_QUERIES) + list(_AQE_SPLIT_QUERIES):
+        s, a = static[qn], adaptive[qn]
+        sr, ar = s.pop("_result"), a.pop("_result")
+        cols = list(sr.columns)
+        sr = sr.sort_values(cols).reset_index(drop=True)
+        ar = ar.sort_values(cols).reset_index(drop=True)
+        parity = True
+        try:
+            pd.testing.assert_frame_equal(
+                sr, ar, check_exact=False, rtol=1e-9
+            )
+        except AssertionError:
+            parity = False
+        out["queries"][qn] = {
+            "static_warm_s": s["warm_s"],
+            "adaptive_warm_s": a["warm_s"],
+            "speedup": round(s["warm_s"] / max(a["warm_s"], 1e-9), 3),
+            "static_best_s": s["warm_best_s"],
+            "adaptive_best_s": a["warm_best_s"],
+            "speedup_best": round(
+                s["warm_best_s"] / max(a["warm_best_s"], 1e-9), 3
+            ),
+            "learning_pass_s": a["first_pass_s"],
+            "adapted_first_pass_s": a["adapted_first_pass_s"],
+            "adaptations": a["adaptations"],
+            "rewrites_per_adapted_run": a["rewrites_last_run"],
+            "skew_flags": a["skew_flags_last_run"],
+            "warm_retraces": a["warm_retraces"],
+            "parity_multiset_exact": parity,
+        }
+    out["skewed_join_speedup_ok"] = (
+        out["queries"]["skewed_join"]["speedup"] >= 1.2
+    )
+
+    # -- TPC-H guardrail: well-estimated plans must not regress --------------
+    tpch = gen_all(scale=tpch_sf)
+    tq = {
+        qn: (QDIR / f"{qn}.sql").read_text()
+        for qn in ("q1", "q3", "q5", "q6", "q18")
+    }
+    g_static = run_arm(False, tq, tpch)
+    g_adapt = run_arm(True, tq, tpch)
+    guard: dict = {}
+    for qn in tq:
+        s, a = g_static[qn], g_adapt[qn]
+        s.pop("_result"), a.pop("_result")
+        guard[qn] = {
+            "aqe_off_warm_s": s["warm_s"],
+            "aqe_on_warm_s": a["warm_s"],
+            "ratio_on_over_off": round(
+                a["warm_s"] / max(s["warm_s"], 1e-9), 3
+            ),
+            "adaptations": a["adaptations"],
+            # closed-vocabulary proof: repeat submissions of the
+            # adapted query must not re-trace
+            "warm_retraces": a["warm_retraces"],
+        }
+    out["tpch_guardrail"] = {
+        "sf": tpch_sf,
+        "queries": guard,
+        # pass = AQE on is never a real regression (>15% slower) on any
+        # tracked well-estimated query; faster is fine (tiny-SF buckets
+        # legitimately coalesce)
+        "no_regression": all(
+            g["ratio_on_over_off"] <= 1.15 for g in guard.values()
+        ),
+    }
+    return out
+
+
 def _scrape_hist_quantiles(text: str, class_token: dict, qfn) -> dict:
     """p50/p90/p99 per query class from scraped ``_bucket`` samples —
     computed with the same interpolation the in-process histograms use."""
@@ -1606,6 +1983,22 @@ def _run_child(env: dict, iters: int, timeout: int, label: str):
 
 
 def main() -> None:
+    if os.environ.get("BENCH_AQE"):
+        # adaptive-vs-static on seeded skewed/misestimated data
+        # (docs/aqe.md): in-process standalone clusters, one arm each
+        sys.path.insert(0, str(HERE))
+        res = run_aqe_suite()
+        (HERE / "BENCH_AQE.json").write_text(json.dumps(res, indent=2))
+        print(json.dumps(res, indent=2), file=sys.stderr)
+        print(json.dumps({
+            "metric": f"aqe_skewed_join_speedup_seed{res['seed']}",
+            "value": res["queries"]["skewed_join"]["speedup"],
+            "unit": "x",
+            "skewed_join_speedup_ok": res["skewed_join_speedup_ok"],
+            "tpch_no_regression": res["tpch_guardrail"]["no_regression"],
+            "adaptations": res["queries"]["skewed_join"]["adaptations"],
+        }))
+        return
     if os.environ.get("BENCH_SLO"):
         # sustained-QPS SLO harness (docs/observability.md): in-process
         # standalone cluster + open-loop load + /api/metrics verdicts

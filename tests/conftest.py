@@ -36,6 +36,14 @@ for _k in (
 ):
     os.environ.pop(_k, None)
 
+# AQE hygiene: a BALLISTA_AQE* override in the developer's shell would
+# force the adaptive policy on (or off) for every in-test scheduler,
+# rewriting plans tests expect verbatim. Tests that exercise AQE set
+# ballista.tpu.aqe in their own session configs (or the env in their
+# SUBPROCESS environments). Stripped BEFORE the CPU_MESH_ENV snapshot.
+for _k in [k for k in os.environ if k.startswith("BALLISTA_AQE")]:
+    os.environ.pop(_k, None)
+
 # Hermetic plan-hint persistence: without this, every in-test TpuContext/
 # Executor would read AND write the developer's real hint file
 # (compilecache/hints.py rides the XLA cache dir), making test behavior

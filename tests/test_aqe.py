@@ -1,0 +1,616 @@
+"""Adaptive query execution (ballista_tpu/scheduler/aqe.py, docs/aqe.md).
+
+The policy layer over the certified-rewrite substrate: unit coverage of
+the strategy store + decision rules, and in-process standalone-cluster
+acceptance of the full loop — observe at StageFinished, learn per query
+class, apply at submission through ``apply_certified_rewrite`` ONLY,
+fall back to the pristine template on any rejection. The q15
+float-equality guard is exercised BY THE POLICY (a learned coalesce is
+proposed and rejected with its clause, and the job completes
+bit-exactly), not just by the rewrite unit tests."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.scheduler import aqe
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _fresh_store():
+    """The strategy store is process-wide (like the compile caches it
+    rides beside); tests must not see each other's learning."""
+    aqe.reset_store()
+    yield
+    aqe.reset_store()
+
+
+def _skewed_tables(n_fact=120_000, n_dim=400, seed=7):
+    """Zipfian int keys + string join keys with the small dim: the
+    wrong-side-build / hot-key shapes the policy exists for."""
+    rng = np.random.default_rng(seed)
+    key = np.minimum(rng.zipf(1.5, size=n_fact), 2000).astype(np.int64)
+    fact = pa.table(
+        {
+            "key": pa.array(key),
+            "skey": pa.array([f"s{int(k) % (n_dim * 4)}" for k in key]),
+            "v": pa.array(rng.uniform(0, 100, n_fact)),
+        }
+    )
+    dim = pa.table(
+        {
+            "skey": pa.array([f"s{i}" for i in range(n_dim)]),
+            "attr": pa.array((np.arange(n_dim) % 7).astype(np.int64)),
+        }
+    )
+    return {"fact": fact, "dim": dim}
+
+
+# wrong-side build: dim JOIN fact puts the big fact on the build side of
+# the string-keyed collect join
+WRONG_BUILD_SQL = (
+    "SELECT f.key, count(*) AS c, sum(f.v) AS s "
+    "FROM dim d JOIN fact f ON d.skey = f.skey "
+    "GROUP BY f.key ORDER BY s DESC LIMIT 20"
+)
+
+
+def _standalone(data, n_executors=1, **settings):
+    from ballista_tpu.client.context import BallistaContext
+
+    cfg = BallistaConfig().with_setting("ballista.shuffle.partitions", "4")
+    for k, v in settings.items():
+        cfg = cfg.with_setting(k.replace("__", "."), v)
+    ctx = BallistaContext.standalone(cfg, n_executors=n_executors)
+    for name, t in data.items():
+        ctx.register_table(name, t)
+    return ctx
+
+
+def _latest_job(sched):
+    with sched._lock:
+        return max(sched.jobs.values(), key=lambda j: j.submitted_s)
+
+
+def _frames_close(a, b, exact=False):
+    cols = list(a.columns)
+    a = a.sort_values(cols).reset_index(drop=True)
+    b = b.sort_values(cols).reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        a, b, check_exact=exact, **({} if exact else {"rtol": 1e-9})
+    )
+
+
+# ---------------------------------------------------------------------------
+# unit: strategy store
+# ---------------------------------------------------------------------------
+
+
+def test_store_learn_get_unlearn_families():
+    s = aqe.StrategyStore()
+    assert s.get("cls") == ()
+    assert s.learn("cls", ("split", 3, 8, 1000))
+    assert s.learn("cls", ("flip", 2, 0))
+    # same-family/same-stage replacement: coalesce retires the split
+    assert s.learn("cls", ("coalesce", 3, 1))
+    specs = s.get("cls")
+    assert ("coalesce", 3, 1) in specs and ("flip", 2, 0) in specs
+    assert not any(sp[0] == "split" for sp in specs)
+    # the nosplit tombstone retires bucket strategies for its stage
+    assert s.learn("cls", ("nosplit", 3, 0))
+    assert s.get("cls") == (("flip", 2, 0), ("nosplit", 3, 0))
+    # different stage in the same family coexists
+    assert s.learn("cls", ("split", 5, 16, 10))
+    assert len(s.get("cls")) == 3
+    assert s.unlearn("cls", ("flip", 2, 0))
+    assert not s.unlearn("cls", ("flip", 2, 0))
+    # unknown/overflow classes never learn (label-cardinality discipline)
+    assert not s.learn("unknown", ("flip", 1, 0))
+    assert not s.learn("overflow", ("flip", 1, 0))
+    assert s.learn("cls", ("split", 5, 32, 10))  # replaces same family
+    assert sum(1 for sp in s.get("cls") if sp[1] == 5) == 1
+    # the deny ledger: a certificate-rejected (family, stage) never
+    # re-learns — the churn guard (docs/aqe.md)
+    s.deny("cls", "coalesce", 9)
+    assert s.is_denied("cls", "split", 9)  # family-wide
+    assert not s.learn("cls", ("split", 9, 8, 1))
+    assert s.learn("cls", ("flip", 9, 0))  # other families unaffected
+
+
+def test_store_persists_through_hint_seam(tmp_path, monkeypatch):
+    """Learned strategies survive a process restart via plan_hints.json
+    (the PR 7 seam) — the fresh-process-plans-adaptively story."""
+    monkeypatch.setenv("BALLISTA_TPU_HINT_CACHE", str(tmp_path))
+    s1 = aqe.StrategyStore()
+    s1.load_once()
+    s1.learn("abcd1234", ("flip", 2, 0))
+    s1.learn("abcd1234", ("split", 3, 8, 500))
+    s1.learn("ffff0000", ("coalesce", 4, 1))
+    # a FRESH store (fresh process) reads them back
+    s2 = aqe.StrategyStore()
+    assert s2.get("abcd1234") == ()  # not loaded yet
+    s2.load_once()
+    assert s2.get("abcd1234") == (("flip", 2, 0), ("split", 3, 8, 500))
+    assert s2.get("ffff0000") == (("coalesce", 4, 1),)
+    # unlearn persists too
+    s2.unlearn("ffff0000", ("coalesce", 4, 1))
+    s3 = aqe.StrategyStore()
+    s3.load_once()
+    assert s3.get("ffff0000") == ()
+    assert s3.get("abcd1234") == (("flip", 2, 0), ("split", 3, 8, 500))
+
+
+def test_store_off_hint_cache_is_process_local(monkeypatch):
+    monkeypatch.setenv("BALLISTA_TPU_HINT_CACHE", "off")
+    s1 = aqe.StrategyStore()
+    s1.load_once()
+    s1.learn("cls", ("flip", 1, 0))
+    s2 = aqe.StrategyStore()
+    s2.load_once()
+    assert s2.get("cls") == ()
+
+
+# ---------------------------------------------------------------------------
+# unit: decision rules + spec plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_decide_bucket_strategy_rules():
+    MB = 1024 * 1024
+    # skew: one bucket 10x the median -> split, growth bounded
+    skewed = {0: (100_000, 10 * MB), 1: (10_000, MB), 2: (10_000, MB),
+              3: (10_000, MB)}
+    kind, n = aqe.decide_bucket_strategy(skewed, 4, 4.0, 4096, 16)
+    assert kind == "split" and 4 < n <= 4 * aqe.SPLIT_MAX_FACTOR
+    # tiny balanced buckets -> coalesce toward the target
+    tiny = {i: (1000, 64 * 1024) for i in range(8)}
+    assert aqe.decide_bucket_strategy(tiny, 8, 4.0, 4096, 16) == (
+        "coalesce", 1,
+    )
+    # balanced, right-sized -> nothing
+    good = {i: (1_000_000, 64 * MB) for i in range(4)}
+    assert aqe.decide_bucket_strategy(good, 4, 4.0, 4096, 16) is None
+    # below the skew noise floor -> no split (coalesce may still apply)
+    small_skew = {0: (3000, MB), 1: (10, MB), 2: (10, MB), 3: (10, MB)}
+    out = aqe.decide_bucket_strategy(small_skew, 4, 4.0, 4096, 0)
+    assert out is None
+    # degenerate inputs decide nothing
+    assert aqe.decide_bucket_strategy({}, 4, 4.0, 0, 16) is None
+    assert aqe.decide_bucket_strategy({0: (1, 1)}, 1, 4.0, 0, 16) is None
+    # split respects the absolute bucket ceiling
+    kind, n = aqe.decide_bucket_strategy(
+        {0: (10_000_000, MB), **{i: (10, 1) for i in range(1, 32)}},
+        32, 2.0, 0, 0,
+    )
+    assert kind == "split" and n <= aqe.SPLIT_BUCKET_CAP
+
+
+def test_spec_describe_and_op_mapping():
+    from ballista_tpu import rewrite as rw
+    from ballista_tpu.errors import RewriteRejected
+
+    assert aqe._op_from_spec(("flip", 2, 1)) == rw.FlipJoinBuildSide(2, 1)
+    assert aqe._op_from_spec(("broadcast", 3, 0)) == rw.SwitchToBroadcast(
+        3, 0
+    )
+    assert aqe._op_from_spec(
+        ("coalesce", 4, 1)
+    ) == rw.CoalesceShufflePartitions(4, 1)
+    # extra learned metadata (observed peak) never reaches the op
+    assert aqe._op_from_spec(
+        ("split", 5, 16, 123456)
+    ) == rw.SplitShufflePartitions(5, 16)
+    with pytest.raises(RewriteRejected):
+        aqe._op_from_spec(("banana", 1, 2))
+    for spec in (("flip", 2, 1), ("split", 5, 16, 9), ("nosplit", 3, 0)):
+        assert f"stage={spec[1]}" in aqe.spec_describe(spec)
+
+
+def test_env_override(monkeypatch):
+    on = BallistaConfig().with_setting("ballista.tpu.aqe", "true")
+    off = BallistaConfig()
+    assert aqe.enabled(on) and not aqe.enabled(off)
+    monkeypatch.setenv("BALLISTA_AQE", "0")
+    assert not aqe.enabled(on)  # the ops kill-switch wins
+    monkeypatch.setenv("BALLISTA_AQE", "on")
+    assert aqe.enabled(off)
+    monkeypatch.setenv("BALLISTA_AQE", "")
+    assert aqe.enabled(on) and not aqe.enabled(off)
+
+
+def test_estimate_subtree_bytes():
+    from ballista_tpu.datatypes import DataType, Field, Schema
+    from ballista_tpu.distributed_plan import UnresolvedShuffleExec
+    from ballista_tpu.exec.scan import MemoryScanExec
+
+    schema = Schema([Field("a", DataType.INT64, False)])
+    t = pa.table({"a": pa.array(np.arange(1000, dtype=np.int64))})
+    scan = MemoryScanExec(t, schema)
+    assert aqe.estimate_subtree_bytes(scan, {}) == t.nbytes
+    u = UnresolvedShuffleExec(7, schema, 2, 2)
+    assert aqe.estimate_subtree_bytes(u, {7: {"bytes": 555}}) == 555
+    # unknowable leaf -> None (a wrong estimate must disable, not steer)
+    assert aqe.estimate_subtree_bytes(u, {}) is None
+
+
+# ---------------------------------------------------------------------------
+# integration: the adaptive loop on a standalone cluster
+# ---------------------------------------------------------------------------
+
+
+def test_adaptive_loop_learns_then_applies_and_surfaces():
+    """The full loop on the wrong-side-build join: run 1 flips IN-JOB at
+    StageFinished (eager off keeps the rewrite window open), run 2
+    applies the learned strategies from submission; REST payloads,
+    timeline markers, Prometheus families, and the history record all
+    surface the decisions."""
+    from ballista_tpu.obs import prometheus as prom
+    from ballista_tpu.scheduler import rest
+
+    # big enough to clear the reactive flip's 1MB build floor
+    data = _skewed_tables(n_fact=300_000)
+    ctx = _standalone(
+        data,
+        n_executors=2,
+        ballista__tpu__aqe="true",
+        ballista__tpu__eager_shuffle="false",
+    )
+    sched = ctx._standalone_cluster.scheduler
+    try:
+        r1 = ctx.sql(WRONG_BUILD_SQL).collect().to_pandas()
+        j1 = _latest_job(sched)
+        # run 1: the reactive flip applied mid-job, before the join
+        # stage was promoted
+        assert j1.total_rewrites >= 1
+        flips = [d for d in j1.aqe_decisions
+                 if d["op"] == "flip" and d["outcome"] == "applied"]
+        assert flips and flips[0]["source"] == "reactive"
+        assert flips[0]["before"]["build_bytes"] > (
+            flips[0]["before"]["probe_bytes"]
+        )
+        # and the class learned strategies for next time
+        specs = aqe.strategy_store().get(j1.query_class)
+        assert any(sp[0] == "flip" for sp in specs)
+
+        r2 = ctx.sql(WRONG_BUILD_SQL).collect().to_pandas()
+        j2 = _latest_job(sched)
+        assert j2.job_id != j1.job_id
+        # run 2: learned strategies applied at submission
+        applied = [d for d in j2.aqe_decisions
+                   if d["outcome"] == "applied"]
+        assert applied and all(d["source"] == "learned" for d in applied)
+        assert j2.total_rewrites == len(applied) >= 1
+        _frames_close(r1, r2)  # multiset-exact certificate class
+
+        # REST surfaces (satellite): /api/job carries the decision logs
+        detail = rest.job_detail(sched, j2.job_id)
+        assert [d["op"] for d in detail["aqe"]] == [
+            d["op"] for d in j2.aqe_decisions
+        ]
+        assert detail["rewrite_log"] and all(
+            r["outcome"] == "applied" and r["rewritten"]
+            for r in detail["rewrite_log"]
+        )
+        # timeline marks rewritten stages
+        tl = rest.job_timeline(sched, j2.job_id)
+        marked = {t["stage_id"] for t in tl["tasks"] if t["rewritten"]}
+        assert marked == set(j2.rewritten_stages) and marked
+        # Prometheus: the AQE family + the rewrite totals, parser-valid
+        text = prom.render(prom.scheduler_families(sched))
+        prom.validate_exposition(text)
+        assert 'ballista_aqe_rewrites_total{op="flip",outcome="applied"}' \
+            in text
+        totals = {
+            line.split(" ")[0]: float(line.split(" ")[1])
+            for line in text.splitlines()
+            if line.startswith(("ballista_plan_rewrites_total",
+                                "ballista_plan_rewrite_rejects_total"))
+        }
+        assert totals["ballista_plan_rewrites_total"] >= 2
+        # history: the terminal record carries the adaptation tally
+        rows = {r["job_id"]: r for r in sched.history.jobs()}
+        assert rows[j2.job_id]["aqe_applied"] == len(applied)
+        assert rows[j2.job_id]["aqe_rejected"] == 0
+    finally:
+        ctx.close()
+
+
+def test_aqe_off_is_inert_and_env_kill_switch(monkeypatch):
+    """aqe=false applies nothing even with a seeded store, and
+    BALLISTA_AQE=0 overrides a session that asked for it."""
+    data = _skewed_tables(n_fact=30_000)
+    # seed a strategy for whatever class the query lands in — any
+    # application would bump total_rewrites
+    for case in ("config_off", "env_kill"):
+        aqe.reset_store()
+        if case == "env_kill":
+            monkeypatch.setenv("BALLISTA_AQE", "0")
+            ctx = _standalone(data, ballista__tpu__aqe="true")
+        else:
+            monkeypatch.delenv("BALLISTA_AQE", raising=False)
+            ctx = _standalone(data)
+        sched = ctx._standalone_cluster.scheduler
+        try:
+            ctx.sql(WRONG_BUILD_SQL).collect()
+            j1 = _latest_job(sched)
+            aqe.strategy_store().learn(
+                j1.query_class, ("coalesce", j1.final_stage_id, 1)
+            )
+            ctx.sql(WRONG_BUILD_SQL).collect()
+            j2 = _latest_job(sched)
+            assert j2.total_rewrites == 0
+            assert j2.total_rewrite_rejects == 0
+            assert j2.aqe_decisions == []
+        finally:
+            ctx.close()
+            monkeypatch.delenv("BALLISTA_AQE", raising=False)
+
+
+def test_q15_float_equality_guard_exercised_by_policy():
+    """The policy LEARNS a coalesce from q15's tiny buckets, PROPOSES it
+    on the next submission, and the certificate's float-sensitivity
+    clause (or a sibling clause) REJECTS at least one proposal — the
+    job completes BIT-exactly on the pristine template and the rejected
+    strategy is unlearned (self-healing, no reject loop)."""
+    import pathlib
+
+    from ballista_tpu.tpch import gen_all
+
+    qdir = pathlib.Path(__file__).resolve().parent.parent / (
+        "benchmarks/queries"
+    )
+    sql = (qdir / "q15.sql").read_text()
+    data = gen_all(scale=0.01)
+
+    base_ctx = _standalone(data)
+    try:
+        base = base_ctx.sql(sql).collect().to_pandas()
+    finally:
+        base_ctx.close()
+    assert len(base) > 0
+
+    ctx = _standalone(data, ballista__tpu__aqe="true")
+    sched = ctx._standalone_cluster.scheduler
+    try:
+        r1 = ctx.sql(sql).collect().to_pandas()
+        j1 = _latest_job(sched)
+        _frames_close(r1, base, exact=True)
+        learned = aqe.strategy_store().get(j1.query_class)
+        assert any(sp[0] == "coalesce" for sp in learned)
+
+        ctx.sql(sql).collect()
+        j2 = _latest_job(sched)
+        rejected = [d for d in j2.aqe_decisions
+                    if d["outcome"] == "rejected"]
+        assert rejected, j2.aqe_decisions
+        clauses = {d["clause"] for d in rejected}
+        assert "float-sensitivity" in clauses, clauses
+        assert j2.total_rewrite_rejects >= 1
+        # the guard held COMPLETELY on this shape: q15's drift-exposed
+        # float equality makes EVERY bucket/broadcast proposal unsafe,
+        # so nothing may be accepted — the job ran on the pristine
+        # templates. (Row-level equality of warm q15 runs is NOT
+        # asserted here: warm passes drift the q15 equality even with
+        # AQE off — the pre-existing engine fragility recorded in
+        # ROADMAP — and with zero accepted rewrites AQE provably
+        # changed nothing about the plan that ran.)
+        assert j2.total_rewrites == 0
+        # rejected learned strategies are unlearned AND denied, so the
+        # observe-side rules cannot re-learn them
+        store = aqe.strategy_store()
+        after = store.get(j2.query_class)
+        for d in rejected:
+            assert not any(
+                sp[0] == d["op"] and sp[1] in d["stage_ids"]
+                for sp in after
+            )
+            assert any(
+                store.is_denied(j2.query_class, d["op"], sid)
+                for sid in d["stage_ids"]
+            )
+        # the rejection is in the REST decision log with its clause
+        from ballista_tpu.scheduler import rest
+
+        detail = rest.job_detail(sched, j2.job_id)
+        assert any(
+            r["outcome"] == "rejected" and r.get("clause")
+            for r in detail["rewrite_log"]
+        )
+        # run 3: the class has SETTLED — nothing proposed, nothing
+        # rejected (no propose/reject churn forever; the deny ledger)
+        ctx.sql(sql).collect()
+        j3 = _latest_job(sched)
+        assert j3.total_rewrites == 0
+        assert j3.total_rewrite_rejects == 0
+        assert j3.aqe_decisions == [], j3.aqe_decisions
+    finally:
+        ctx.close()
+
+
+@pytest.mark.parametrize("n_executors", [1, 2])
+def test_policy_vs_certificate_disagreement(n_executors):
+    """Satellite: a policy that PROPOSES an illegal rewrite must log a
+    rejection with its clause and complete bit-exactly on the pristine
+    template — in-proc and 2-executor standalone. The seeded strategies
+    are structurally wrong on purpose (a split of a consumer with no
+    keyed producers, a flip of a stage with no eligible join): the
+    certificate, not the policy, is the safety boundary."""
+    data = _skewed_tables(n_fact=30_000)
+    off_ctx = _standalone(data, n_executors=n_executors)
+    try:
+        base = off_ctx.sql(WRONG_BUILD_SQL).collect().to_pandas()
+    finally:
+        off_ctx.close()
+
+    ctx = _standalone(
+        data, n_executors=n_executors, ballista__tpu__aqe="true",
+        # keep the genuine rules quiet so ONLY the seeded illegal
+        # proposals act
+        ballista__tpu__aqe_target_partition_mb="0",
+        ballista__tpu__aqe_broadcast_threshold_mb="0",
+        ballista__tpu__skew_ratio="0",
+    )
+    sched = ctx._standalone_cluster.scheduler
+    try:
+        ctx.sql(WRONG_BUILD_SQL).collect()
+        j1 = _latest_job(sched)
+        # seed illegal strategies for this exact class: the final stage
+        # reads only the unkeyed agg exchange (split must reject), and
+        # stage 1 (the collect build producer) holds no flippable join
+        store = aqe.strategy_store()
+        store.learn(j1.query_class, ("split", j1.final_stage_id, 8, 1))
+        store.learn(j1.query_class, ("flip", 1, 0))
+        got = ctx.sql(WRONG_BUILD_SQL).collect().to_pandas()
+        j2 = _latest_job(sched)
+        rejected = [d for d in j2.aqe_decisions
+                    if d["outcome"] == "rejected"]
+        assert len(rejected) == 2, j2.aqe_decisions
+        assert all(d["clause"] == "op-applicability" for d in rejected)
+        assert j2.total_rewrites == 0
+        assert j2.total_rewrite_rejects == 2
+        # pristine template served the job: BIT-exact (nothing moved)
+        _frames_close(got, base, exact=True)
+        # both bogus strategies self-healed away
+        assert store.get(j2.query_class) == ()
+    finally:
+        ctx.close()
+
+
+def test_input_skew_flags_final_stage_before_completion():
+    """Skew-monitor timing regression (satellite): the final stage's
+    input-bucket skew must be flagged at the LAST StageFinished — when
+    its producers complete — not first at job completion. The hot-key
+    groupby plans with the final aggregate as the terminal stage, so
+    its input buckets are the keyed partial-agg output."""
+    rng = np.random.default_rng(3)
+    n = 60_000
+    # a JOIN, not a groupby: partial aggregation collapses row mass to
+    # distinct keys (balanced buckets), but a partitioned join's input
+    # buckets carry the raw Zipfian mass — the hot key's bucket is hot
+    key = np.minimum(rng.zipf(1.7, size=n), 500).astype(np.int64)
+    data = {
+        "fact": pa.table(
+            {"key": pa.array(key),
+             "v": pa.array(rng.uniform(0, 1, n))}
+        ),
+        "hdim": pa.table(
+            {"key": pa.array(np.arange(1, 501, dtype=np.int64)),
+             "attr": pa.array((np.arange(500) % 9).astype(np.int64))}
+        ),
+    }
+    ctx = _standalone(
+        data,
+        ballista__tpu__skew_ratio="2",
+        ballista__tpu__skew_min_rows="64",
+        ballista__tpu__trace="on",  # the span-attr proof below
+    )
+    sched = ctx._standalone_cluster.scheduler
+    flags_at_completion = {}
+    orig = sched._on_job_finished
+
+    def spy(job_id):
+        job = sched._get_job(job_id)
+        if job is not None:
+            with sched._lock:
+                flags_at_completion[job_id] = list(job.skew_flags)
+        return orig(job_id)
+
+    sched._on_job_finished = spy
+    try:
+        # no aggregate/sort above the join: the TERMINAL stage is the
+        # partitioned join itself, reading the keyed hash buckets
+        ctx.sql(
+            "SELECT f.key, h.attr, f.v "
+            "FROM fact f JOIN hdim h ON f.key = h.key"
+        ).collect()
+        job = _latest_job(sched)
+        final = job.final_stage_id
+        flagged = flags_at_completion[job.job_id]
+        assert any(sid == final for sid, _ in flagged), (
+            "final-stage input skew was not flagged before job "
+            f"completion: {flagged}"
+        )
+        # and the flag came from the pre-run INPUT pass (trace proof)
+        spans = [s for s in job.spans.values() if s.name == "skew"]
+        assert any(
+            s.attrs.get("source") == "input"
+            and s.attrs.get("stage_id") == final
+            for s in spans
+        ), [s.attrs for s in spans]
+    finally:
+        sched._on_job_finished = orig
+        ctx.close()
+
+
+def test_explain_analyze_narration():
+    """EXPLAIN ANALYZE prints the aqe narration row: class token +
+    learned strategies (docs/aqe.md)."""
+    from ballista_tpu.exec.context import TpuContext
+
+    ctx = TpuContext()
+    ctx.register_table(
+        "t",
+        pa.table({"a": pa.array(np.arange(100, dtype=np.int64)),
+                  "v": pa.array(np.arange(100, dtype=np.float64))}),
+    )
+    out = ctx.sql(
+        "EXPLAIN ANALYZE SELECT a, sum(v) FROM t GROUP BY a"
+    ).collect().to_pydict()
+    rows = dict(zip(out["plan_type"], out["plan"]))
+    assert "aqe" in rows
+    # aqe off + nothing learned: the cheap line (no second planning
+    # pass is paid on a profiling verb for nothing to say)
+    assert "aqe=off: no learned strategies" in rows["aqe"]
+    # seed a strategy for this query's distributed class and re-narrate
+    from ballista_tpu.exec.planner import PhysicalPlanner
+    from ballista_tpu.obs.qclass import plan_class
+    from ballista_tpu.plan.optimizer import optimize
+
+    phys = PhysicalPlanner(
+        ctx, ctx.config.default_shuffle_partitions(), config=ctx.config,
+        distributed=True,
+    ).plan(optimize(ctx.sql_to_logical(
+        "SELECT a, sum(v) FROM t GROUP BY a"
+    )))
+    qclass = plan_class(phys)
+    aqe.strategy_store().learn(qclass, ("coalesce", 2, 1))
+    out2 = ctx.sql(
+        "EXPLAIN ANALYZE SELECT a, sum(v) FROM t GROUP BY a"
+    ).collect().to_pydict()
+    rows2 = dict(zip(out2["plan_type"], out2["plan"]))
+    assert f"aqe=off class={qclass}" in rows2["aqe"]
+    assert "would apply coalesce(stage=2, n=1)" in rows2["aqe"]
+
+
+def test_history_rest_payload_carries_aqe_counts():
+    """GET /api/history rows (and system.queries' REST source) carry the
+    aqe_applied/aqe_rejected tally; JSON-serializable end to end."""
+    data = _skewed_tables(n_fact=30_000)
+    ctx = _standalone(data, ballista__tpu__aqe="true")
+    sched = ctx._standalone_cluster.scheduler
+    try:
+        ctx.sql(WRONG_BUILD_SQL).collect()
+        ctx.sql(WRONG_BUILD_SQL).collect()
+        j2 = _latest_job(sched)
+        rows = sched.history_payload("queries")
+        by_id = {r["job_id"]: r for r in rows}
+        applied = sum(
+            1 for d in j2.aqe_decisions if d["outcome"] == "applied"
+        )
+        assert by_id[j2.job_id]["aqe_applied"] == applied >= 1
+        json.dumps(rows)  # REST-serializable
+        # the decision payloads themselves serialize too (rest.job_detail)
+        from ballista_tpu.scheduler import rest
+
+        json.dumps(rest.job_detail(sched, j2.job_id))
+        json.dumps(rest.job_timeline(sched, j2.job_id))
+    finally:
+        ctx.close()

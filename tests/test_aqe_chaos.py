@@ -1,0 +1,212 @@
+"""Chaos acceptance for adaptive query execution (ISSUE 15, docs/aqe.md).
+
+A 2-executor cluster runs the skewed/misestimated join+groupby with the
+AQE policy ON: pass 1 learns (build-side flip + agg coalesce), pass 2
+applies the learned strategies at submission — then an executor is
+killed mid-run (shuffle files deleted) on a job that has ALREADY
+accepted >= 1 AQE rewrite. Lineage recovery must complete the adapted
+job multiset-exact (the flip/coalesce certificate class: float
+aggregates to 1e-9 relative, everything else bit-exact) vs the clean
+adapted run, the replay witness must be clean, and the resource witness
+must drain to zero."""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from tests.conftest import CPU_MESH_ENV
+
+SCRIPT = r"""
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from ballista_tpu.analysis import replay, reswitness
+from ballista_tpu.client.context import BallistaContext
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.scheduler import aqe
+from ballista_tpu.testing import faults
+
+rng = np.random.default_rng(7)
+n_fact, n_dim = 300_000, 400
+key = np.minimum(rng.zipf(1.5, size=n_fact), 2000).astype(np.int64)
+DATA = {
+    "fact": pa.table({
+        "key": pa.array(key),
+        "skey": pa.array([f"s{int(k) % (n_dim * 4)}" for k in key]),
+        "v": pa.array(rng.uniform(0, 100, n_fact)),
+    }),
+    "dim": pa.table({
+        "skey": pa.array([f"s{i}" for i in range(n_dim)]),
+        "attr": pa.array((np.arange(n_dim) % 7).astype(np.int64)),
+    }),
+}
+SQL = (
+    "SELECT f.key, count(*) AS c, sum(f.v) AS s "
+    "FROM dim d JOIN fact f ON d.skey = f.skey "
+    "GROUP BY f.key ORDER BY s DESC LIMIT 50"
+)
+
+
+def make_ctx():
+    cfg = (
+        BallistaConfig()
+        .with_setting("ballista.shuffle.partitions", "4")
+        .with_setting("ballista.tpu.aqe", "true")
+        .with_setting("ballista.tpu.fetch_backoff_ms", "10")
+    )
+    ctx = BallistaContext.standalone(
+        cfg,
+        n_executors=2,
+        executor_timeout_s=2.0,
+        expiry_check_interval_s=0.5,
+    )
+    for name, t in DATA.items():
+        ctx.register_table(name, t)
+    return ctx
+
+
+def latest(sched):
+    with sched._lock:
+        return max(sched.jobs.values(), key=lambda j: j.submitted_s)
+
+
+# ---- clean adaptive reference: learn, then the adapted steady state ---------
+aqe.reset_store()
+clean_ctx = make_ctx()
+clean_sched = clean_ctx._standalone_cluster.scheduler
+clean_ctx.sql(SQL).collect()  # learning pass
+clean = clean_ctx.sql(SQL).collect().to_pandas()
+cj = latest(clean_sched)
+assert cj.total_rewrites >= 1, "clean adapted pass accepted no rewrite"
+applied_clean = sorted(
+    d["op"] for d in cj.aqe_decisions if d["outcome"] == "applied"
+)
+clean_ctx.close()
+print("CLEAN-ADAPTED-OK", len(clean), applied_clean)
+
+# ---- chaos pass: witnesses on, kill an executor mid-adapted-run -------------
+faults.install([{"point": "fetch_slow", "delay_s": 0.05}], seed=42)
+replay.enable()
+reswitness.enable()
+ctx = make_ctx()
+cluster = ctx._standalone_cluster
+sched = cluster.scheduler
+
+result = {}
+errors = []
+
+
+def drive():
+    try:
+        result["r"] = ctx.sql(SQL).collect().to_pandas()
+    except Exception as e:  # noqa: BLE001
+        errors.append(repr(e))
+
+
+t = threading.Thread(target=drive)
+t.start()
+
+# the learned strategies apply AT SUBMISSION: wait until the in-flight
+# job has accepted >= 1 AQE rewrite AND holds completed shuffle output,
+# then kill the executor that owns some of it
+deadline = time.time() + 120
+victim_id = None
+while time.time() < deadline and victim_id is None:
+    jobs = list(sched.jobs.values())
+    if jobs and jobs[0].status == "running" and (
+        jobs[0].total_rewrites >= 1
+    ):
+        for (jid, sid), stage in list(sched.stage_manager._stages.items()):
+            for task in stage.tasks:
+                if task.state.value == "completed" and task.executor_id:
+                    victim_id = task.executor_id
+                    break
+            if victim_id:
+                break
+    time.sleep(0.01)
+job = next(iter(sched.jobs.values()))
+assert job.total_rewrites >= 1, "no AQE rewrite accepted before the kill"
+if victim_id is not None and job.status == "running":
+    victim_idx = next(
+        i for i, h in enumerate(cluster.executors)
+        if h.executor.executor_id == victim_id
+    )
+    cluster.kill_executor(victim_idx, lose_shuffle=True)
+    print("KILLED", victim_idx)
+else:
+    print("KILL-SKIPPED", job.status)
+
+t.join(timeout=600)
+assert not t.is_alive(), "adapted query wedged after the kill"
+assert not errors, errors
+job = next(iter(sched.jobs.values()))
+assert job.status == "completed", (job.status, job.error)
+assert job.total_rewrites >= 1
+applied_chaos = sorted(
+    d["op"] for d in job.aqe_decisions if d["outcome"] == "applied"
+)
+assert applied_chaos == applied_clean, (applied_chaos, applied_clean)
+print(
+    "CHAOS-ADAPTED-OK rewrites:", job.total_rewrites,
+    "retries:", job.total_retries, "recomputes:", job.total_recomputes,
+)
+
+# replay witness: traffic seen, zero mismatches across the recovery
+counts = replay.record_counts()
+assert counts.get("shuffle", 0) > 0 and counts.get("result", 0) > 0, counts
+replay.assert_clean()
+print("REPLAY-WITNESS-OK", replay.summary())
+
+# multiset-exact vs the clean adapted run (the flip/coalesce
+# certificate class: float aggregates re-associate in the last ULP)
+got = result["r"]
+assert list(got.columns) == list(clean.columns)
+ck = clean.sort_values(list(clean.columns)).reset_index(drop=True)
+gk = got.sort_values(list(got.columns)).reset_index(drop=True)
+pd.testing.assert_frame_equal(gk, ck, check_exact=False, rtol=1e-9)
+for col in ("f.key", "c"):
+    assert (gk[col].to_numpy() == ck[col].to_numpy()).all(), col
+print("MULTISET-EXACT-OK")
+
+# zero leaked resources after teardown
+ctx.close()
+reswitness.assert_drained()
+acq = reswitness.acquired_counts()
+assert sum(acq.values()) > 0, acq
+print("ZERO-LEAKS-OK")
+faults.install(None)
+print("AQE-CHAOS-OK")
+"""
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # two clusters + kill/recompute waits; the policy's
+# unit/integration semantics stay tier-1 in tests/test_aqe.py
+def test_executor_kill_mid_run_on_aqe_adapted_job():
+    env = {k: v for k, v in CPU_MESH_ENV.items() if k != "XLA_FLAGS"}
+    proc = subprocess.run(
+        [sys.executable, "-c", SCRIPT],
+        env=env,
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
+        capture_output=True,
+        text=True,
+        timeout=1500,
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-4000:]}"
+    )
+    for marker in (
+        "CLEAN-ADAPTED-OK", "KILLED", "CHAOS-ADAPTED-OK",
+        "REPLAY-WITNESS-OK", "MULTISET-EXACT-OK", "ZERO-LEAKS-OK",
+        "AQE-CHAOS-OK",
+    ):
+        assert marker in proc.stdout, (
+            f"missing {marker}\nstdout:\n{proc.stdout}\n"
+            f"stderr:\n{proc.stderr[-4000:]}"
+        )

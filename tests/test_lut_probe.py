@@ -164,3 +164,83 @@ def test_exact2_contiguous_first_key_probe():
             assert np.array_equal(
                 np.asarray(cr)[keep], np.asarray(cg)[keep]
             ), (kind, ci)
+
+
+def test_dict_keyed_build_lut_cache_never_poisons(monkeypatch):
+    """Exec-level regression (found by the AQE build-side flip): a
+    dictionary-keyed build's code domain GROWS every time a probe batch
+    unifies new strings into its dictionary, so the cross-run
+    ``join_lut`` plan-cache entry re-poisoned itself — learn the first
+    build's range, outgrow it on the next unification, SpeculationMiss,
+    invalidate, relearn — until the retry bound failed the task.
+    Dict-keyed builds must take the fresh-flags path (no cache) and the
+    join must complete correctly with a many-batch probe stream."""
+    import pyarrow as pa
+
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.exec.context import TpuContext
+    from ballista_tpu.exec.joins import HashJoinExec
+
+    monkeypatch.setattr(HashJoinExec, "_LUT_MIN_PROBE", 1)
+    n_dim = 400
+
+    def strings(lo: int, hi: int, reps: int):
+        return pa.array(
+            [f"s{i}" for _ in range(reps) for i in range(lo, hi)]
+        )
+
+    # three probe sources with DISJOINT string domains: each scan batch
+    # carries its OWN dictionary (one registered table's dictionary is
+    # table-wide, which hides the growth — shuffle files from separate
+    # map tasks, the distributed shape, do not), so every union arm
+    # unifies NEW entries into the build dictionary. The first arm's
+    # learned domain (~1200 codes, rounded to the 2048 capacity floor)
+    # is outgrown by the later arms (cumulative ~20k codes).
+    facts = {
+        "fact1": (0, 800),
+        "fact2": (800, 5000),
+        "fact3": (5000, 20000),
+    }
+    dim = pa.table(
+        {
+            "skey": pa.array([f"s{i}" for i in range(n_dim)]),
+            "attr": pa.array([i % 7 for i in range(n_dim)]),
+        }
+    )
+    union = " UNION ALL ".join(
+        f"SELECT skey, v FROM {t}" for t in facts
+    )
+    # fact side first: the BUILD is the small dict-keyed dim, the probe
+    # the multi-dictionary union stream — the poisoning shape
+    sql = (
+        "SELECT count(*) AS c, sum(f.v) AS s "
+        f"FROM ({union}) f JOIN dim d ON f.skey = d.skey"
+    )
+
+    ctx = TpuContext(BallistaConfig())
+    fact_tables = {
+        t: pa.table(
+            {
+                "skey": strings(lo, hi, 2),
+                "v": pa.array(
+                    [float(i % 97) for i in range(2 * (hi - lo))]
+                ),
+            }
+        )
+        for t, (lo, hi) in facts.items()
+    }
+    for t, tab in fact_tables.items():
+        ctx.register_table(t, tab)
+    ctx.register_table("dim", dim)
+    # twice through the SAME context: the second run hits whatever the
+    # first left in the shared plan cache
+    first = ctx.sql(sql).collect().to_pydict()
+    second = ctx.sql(sql).collect().to_pydict()
+    assert first == second
+    # only fact1's first n_dim distinct keys match the dim, twice each
+    f1 = fact_tables["fact1"].to_pydict()
+    exp_s = sum(
+        v for k, v in zip(f1["skey"], f1["v"]) if int(k[1:]) < n_dim
+    )
+    assert first["c"] == [2 * n_dim]
+    assert abs(first["s"][0] - exp_s) < 1e-6 * max(1.0, exp_s)

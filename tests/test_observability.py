@@ -240,7 +240,9 @@ def test_explain_analyze_annotates_every_operator():
         "group by k order by k"
     ).collect()
     kinds = t.column("plan_type").to_pylist()
-    assert kinds == ["physical_plan (analyzed)", "analyze_summary"]
+    # "aqe" rides along since PR 15: the class token + learned-strategy
+    # narration (docs/aqe.md, pinned in tests/test_aqe.py)
+    assert kinds == ["physical_plan (analyzed)", "analyze_summary", "aqe"]
     body = t.column("plan").to_pylist()[0]
     for line in body.splitlines():
         assert "rows=" in line and "elapsed=" in line and "bytes=" in line, (
