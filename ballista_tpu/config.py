@@ -109,6 +109,16 @@ BALLISTA_COST_ACCOUNTING = (
 BALLISTA_HISTORY_RETENTION_JOBS = (
     "ballista.tpu.history_retention_jobs"  # persistent query-log bound
 )
+# serving fast path (docs/serving.md)
+BALLISTA_RESULT_CACHE_MB = (
+    "ballista.tpu.result_cache_mb"  # scheduler-side result cache (0 = off)
+)
+BALLISTA_SINGLE_STAGE_BYPASS = (
+    "ballista.tpu.single_stage_bypass"  # skip stage machinery for 1-task jobs
+)
+BALLISTA_TASK_GRANT_BATCH = (
+    "ballista.tpu.task_grant_batch"  # tasks per PollWork round-trip
+)
 
 METRICS_COLLECTORS = ("shipping", "logging")
 
@@ -844,6 +854,47 @@ def _entries() -> dict[str, ConfigEntry]:
             int,
         ),
         ConfigEntry(
+            BALLISTA_RESULT_CACHE_MB,
+            "Scheduler-side result cache budget in MB (docs/serving.md): "
+            "a bounded LRU keyed by the canonical optimized-plan "
+            "fingerprint composed with the registered tables' data "
+            "versions. A repeated identical query over unchanged data is "
+            "served straight from the scheduler — no stages, no "
+            "executor round-trip — with the hit/miss/bytes counters on "
+            "/api/metrics and a `cache` event in the job trace. "
+            "Re-registering or appending to a table changes its data "
+            "version and naturally misses; system.* tables are never "
+            "cached. 0 (default) disables the cache entirely.",
+            "0",
+            int,
+        ),
+        ConfigEntry(
+            BALLISTA_SINGLE_STAGE_BYPASS,
+            "Single-stage orchestration bypass (docs/serving.md): when "
+            "stage splitting yields exactly one stage with one input "
+            "partition, skip the stage state machine and hand the plan "
+            "out as ONE direct task grant; the result streams back "
+            "through the normal Flight path. JobInfo, history, cost "
+            "accounting, queue-wait metering, and traces see bypassed "
+            "jobs identically (a `bypass` trace event marks them). "
+            "Failed grants retry bounded by task_max_attempts, exactly "
+            "like staged tasks.",
+            "true",
+            _parse_bool,
+        ),
+        ConfigEntry(
+            BALLISTA_TASK_GRANT_BATCH,
+            "Max tasks one PollWork round-trip may grant "
+            "(docs/serving.md): executors advertise their free slots on "
+            "each poll and the scheduler fills up to "
+            "min(free_slots, this) task definitions into the reply, "
+            "collapsing per-task RPC chatter at high QPS. 1 restores "
+            "the one-task-per-poll reference behavior. Read from the "
+            "SCHEDULER's config (PollWork has no session).",
+            "4",
+            int,
+        ),
+        ConfigEntry(
             BALLISTA_EAGER_WAIT_S,
             "Deadline (seconds) an eager reader waits for a "
             "not-yet-published upstream location before failing the task "
@@ -1048,6 +1099,15 @@ class BallistaConfig:
 
     def history_retention_jobs(self) -> int:
         return max(1, self._get(BALLISTA_HISTORY_RETENTION_JOBS))
+
+    def result_cache_mb(self) -> int:
+        return max(0, self._get(BALLISTA_RESULT_CACHE_MB))
+
+    def single_stage_bypass(self) -> bool:
+        return self._get(BALLISTA_SINGLE_STAGE_BYPASS)
+
+    def task_grant_batch(self) -> int:
+        return max(1, self._get(BALLISTA_TASK_GRANT_BATCH))
 
     def __eq__(self, other) -> bool:
         return (

@@ -74,6 +74,15 @@ class Executor:
         # its learned join strategies / capacities (docs/compile_cache.md)
         self._capacity_hint: dict = {}
         self._plan_cache: dict = {}
+        # job-scoped strategy snapshots (the q15 warm-pass drift fix):
+        # every task of one job seeds its attempt cache from the SAME
+        # frozen view of the learned strategies — see _job_snapshot
+        import collections as _collections
+
+        self._snapshot_lock = make_lock("Executor._snapshot_lock")
+        self._job_snapshots: _collections.OrderedDict = (
+            _collections.OrderedDict()
+        )
         from ballista_tpu.compilecache.hints import HintStore
 
         self._hints = HintStore()
@@ -181,6 +190,33 @@ class Executor:
                 ch.close()
             except Exception:  # noqa: BLE001
                 pass
+
+    def _job_snapshot(self, job_id: str) -> dict:
+        """The frozen strategy view every task of one job seeds from —
+        the q15 warm-pass drift fix (docs/serving.md).
+
+        The plan cache is executor-lifetime: without job scoping, task
+        N's freshly committed observations (shrink re-measurement,
+        flip-streaming adoption) were visible to task N+1 of the SAME
+        job, so two structurally identical subplans — q15's revenue
+        subquery appears in both the aggregate branch and the
+        max-equality filter branch — could fold their partial sums in
+        different orders. The last-ULP float drift that causes is
+        invisible almost everywhere, but q15's ``total_revenue =
+        (SELECT max(...))`` equality turns it into a silently EMPTY
+        result on warm passes. Snapshotting per job makes strategy
+        adoption atomic at the job boundary: commits still flow to
+        ``_plan_cache`` (future jobs warm up as before), but never
+        mid-job. Bounded FIFO — entries are tiny (a dict of strategy
+        keys) and a job only needs its entry while its tasks run."""
+        with self._snapshot_lock:
+            snap = self._job_snapshots.get(job_id)
+            if snap is None:
+                snap = dict(self._plan_cache)
+                self._job_snapshots[job_id] = snap
+                while len(self._job_snapshots) > 64:
+                    self._job_snapshots.popitem(last=False)
+            return snap
 
     def execute_shuffle_write(
         self, task: pb.TaskDefinition
@@ -303,7 +339,11 @@ class Executor:
         # diverge from a clean execution — observed as last-ULP float
         # drift in aggregates, breaking the chaos suite's bit-exact
         # recovery guarantee (docs/fault_tolerance.md).
-        attempt_cache = dict(self._plan_cache)
+        # The snapshot is JOB-scoped, not executor-lifetime: task N's
+        # freshly committed observations must not be adopted mid-job by
+        # task N+1 of the SAME job — see _job_snapshot (the q15
+        # warm-pass drift fix).
+        attempt_cache = dict(self._job_snapshot(task.task_id.job_id))
 
         def attempt(ctx):
             # fresh metrics per ATTEMPT: a capacity/speculation retry
@@ -635,9 +675,18 @@ class PollLoop:
                     statuses.append(self._statuses.get_nowait())
                 except queue.Empty:
                     break
-            can_accept = self._available.acquire(blocking=False)
-            if can_accept:
+            # free-slot count for batched grants (docs/serving.md):
+            # drain the semaphore non-blocking, count, release. This
+            # thread is the only grant consumer, so the count only ever
+            # UNDER-advertises (a task finishing mid-count frees a slot
+            # we don't report) — the scheduler never grants more tasks
+            # than the _run_task acquires below can absorb unblocked.
+            free_slots = 0
+            while self._available.acquire(blocking=False):
+                free_slots += 1
+            for _ in range(free_slots):
                 self._available.release()
+            can_accept = free_slots > 0
             from ballista_tpu.compilecache import metrics as compile_metrics
             from ballista_tpu.obs import hist as obs_hist
             from ballista_tpu.obs import trace as obs_trace
@@ -661,6 +710,7 @@ class PollLoop:
                         # (docs/observability.md)
                         spans=[obs_trace.span_to_proto(s) for s in spans],
                         hists=obs_hist.deltas_to_proto(hist_deltas),
+                        free_slots=free_slots,
                     )
                 )
             except grpc.RpcError as e:
@@ -676,8 +726,15 @@ class PollLoop:
                 obs_hist.REGISTRY.requeue_deltas(hist_deltas)
                 time.sleep(1.0)
                 continue
-            if result.HasField("task"):
-                self._run_task(result.task)
+            # batched grants (docs/serving.md): a batching scheduler
+            # fills `tasks` (first grant mirrored into `task`); a
+            # pre-batching scheduler sets only `task`
+            tasks = list(result.tasks)
+            if not tasks and result.HasField("task"):
+                tasks = [result.task]
+            if tasks:
+                for td in tasks:
+                    self._run_task(td)
             else:
                 time.sleep(POLL_INTERVAL)
 

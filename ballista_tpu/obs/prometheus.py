@@ -261,6 +261,38 @@ def scheduler_families(server) -> list[tuple]:
          "(bounded by ballista.tpu.history_retention_jobs)",
          [({}, server.history.job_count())])
     )
+    # serving fast path (docs/serving.md): result-cache effectiveness and
+    # the orchestration-bypass count — the two fleet signals the
+    # BENCH_SERVE artifact reports straight from this scrape
+    cache = server.result_cache.stats()
+    families.append(
+        ("ballista_result_cache_events_total", "counter",
+         "Result-cache lookups and maintenance by outcome (hit|miss|"
+         "eviction|rejected_oversize — docs/serving.md)",
+         [({"outcome": "hit"}, cache["hits"]),
+          ({"outcome": "miss"}, cache["misses"]),
+          ({"outcome": "eviction"}, cache["evictions"]),
+          ({"outcome": "rejected_oversize"}, cache["rejected_oversize"])])
+    )
+    families.append(
+        ("ballista_result_cache_entries", "gauge",
+         "Committed results currently held by the plan-fingerprint "
+         "result cache", [({}, cache["entries"])])
+    )
+    families.append(
+        ("ballista_result_cache_bytes", "gauge",
+         "Result-cache resident bytes vs its configured capacity",
+         [({"kind": "used"}, cache["bytes"]),
+          ({"kind": "capacity"}, cache["capacity_bytes"])])
+    )
+    with server._lock:
+        bypass_total = server.obs_bypass_total
+    families.append(
+        ("ballista_bypass_jobs_total", "counter",
+         "Jobs served through the single-stage orchestration bypass "
+         "(no QueryStageScheduler state machine — docs/serving.md)",
+         [({}, bypass_total)])
+    )
     families.append(
         ("ballista_desired_executors", "gauge",
          "Composite autoscale pressure: executors the KEDA ExternalScaler "

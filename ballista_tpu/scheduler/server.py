@@ -188,6 +188,15 @@ class JobInfo:
     # included, because the tenant paid for them too. None until the
     # first costed attempt reports (accounting off = stays None).
     cost: object = None
+    # serving fast path (docs/serving.md): the result-cache key this
+    # job's committed result will be stored under (None = uncacheable or
+    # cache off); the cached Arrow IPC payload when the job was SERVED
+    # from the cache (GetJobStatus ships it in CompletedJob.result_ipc);
+    # and the single-stage-bypass flag (task granted/completed outside
+    # the stage state machine).
+    cache_key: object = None
+    result_ipc: bytes = b""
+    bypass: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -385,6 +394,20 @@ class SchedulerServer:
 
         self.aqe = AqePolicy(self)
         self.obs_aqe_total: dict[tuple[str, str], int] = {}
+        # serving fast path (docs/serving.md). Result cache: capacity
+        # comes from the SCHEDULER's config (sessions cannot resize a
+        # shared cache); keys fold in the session settings, so different
+        # sessions never collide. In-memory only by design — a restarted
+        # scheduler starts cold, which is the no-stale-serve-after-
+        # _recover_state contract. Bypass bookkeeping: jobs granted
+        # outside the stage state machine, all guarded by _lock.
+        from ballista_tpu.scheduler.result_cache import ResultCache
+
+        self.result_cache = ResultCache(self.config.result_cache_mb() << 20)
+        self._bypass_pending: collections.deque = collections.deque()
+        self._bypass_running: dict[str, str] = {}  # job_id -> executor_id
+        self._bypass_attempts: dict[str, int] = {}
+        self.obs_bypass_total = 0
         self.state = None
         if state_backend is not None:
             from ballista_tpu.scheduler.persistent_state import (
@@ -442,6 +465,18 @@ class SchedulerServer:
             return []
         for eid in expired:
             self._drop_executor(eid)
+        # bypass grants die with their executor exactly like RUNNING
+        # stage tasks: requeue without charging an attempt (the blame is
+        # the executor's, not the task's) — docs/serving.md
+        with self._lock:
+            lost_bypass = sorted(
+                jid
+                for jid, ex in self._bypass_running.items()
+                if ex in expired
+            )
+            for jid in lost_bypass:
+                del self._bypass_running[jid]
+                self._bypass_pending.append(jid)
         reset = self.stage_manager.reset_tasks_of_executors(expired)
         log.warning(
             "executors %s expired; reset %d running tasks", expired, len(reset)
@@ -640,6 +675,25 @@ class SchedulerServer:
         verify = cfg.verify_plans()
         with self._trace_step(tctx, "plan"):
             optimized = optimize(logical)
+            # serving fast path (docs/serving.md): a repeated identical
+            # query over unchanged data is answered from the result
+            # cache right here — no physical planning, no stages, no
+            # executor. The key folds in the session settings and the
+            # provider's data versions; result_cache_key returns None
+            # (uncacheable, counted as a miss) for system.* scans or
+            # when no data-version-capable provider is attached.
+            cache_key = None
+            if self.result_cache.enabled:
+                from ballista_tpu.scheduler.result_cache import (
+                    result_cache_key,
+                )
+
+                cache_key = result_cache_key(optimized, cfg, self.provider)
+                entry = self.result_cache.get(cache_key)
+                if entry is not None:
+                    return self._serve_cached_result(
+                        entry, session_id, trace=tctx
+                    )
             if verify:
                 # submission-time gate: reject inconsistent plans with a
                 # typed PlanVerificationError (naming the operator path)
@@ -666,7 +720,9 @@ class SchedulerServer:
                     from ballista_tpu.analysis import verify_physical
 
                     verify_physical(physical)
-        return self.submit_physical(physical, session_id, trace=tctx)
+        return self.submit_physical(
+            physical, session_id, trace=tctx, cache_key=cache_key
+        )
 
     def _mesh_planning_runtime(self, cfg):
         """Planning-only mesh handle: when the session keeps collective
@@ -691,11 +747,73 @@ class SchedulerServer:
         )
         return _MeshPlanningHandle() if capable else None
 
+    def _serve_cached_result(
+        self, entry: tuple[bytes, dict], session_id: str,
+        trace: dict | None,
+    ) -> str:
+        """Mint a COMPLETED job for a result-cache hit (docs/serving.md).
+
+        The job is real everywhere observability and charging look:
+        history gets its submit + terminal records, the fleet latency
+        histogram observes it under the ORIGINATING run's query class
+        (carried in the cache entry — physical planning was skipped, so
+        the class cannot be recomputed), and a traced session sees a
+        ``cache`` event under the job root. Not written to the state
+        backend: the payload lives only in this process, and recovering
+        a "completed" job with no locations and no payload would serve
+        an empty result — unknown-after-restart fails loudly instead.
+        """
+        payload, meta = entry
+        qclass = meta.get("query_class", "unknown")
+        job_id = generate_job_id()
+        import time as _time
+
+        now = _time.time()
+        with self._lock:
+            job = JobInfo(
+                job_id=job_id, session_id=session_id, status="completed"
+            )
+            job.query_class = qclass
+            job.submitted_s = now
+            job.result_ipc = payload
+            if trace is not None:
+                job.trace_id = trace["trace_id"]
+                root = trace["root"]
+                root.attrs["job_id"] = job_id
+                job.root_span_id = root.span_id
+                job.root_span = root
+                self._traces[job.trace_id] = job_id
+                for s in trace["pre"]:
+                    job.spans[s.span_id] = s
+            self.jobs[job_id] = job
+        self._job_event(
+            job, "cache", attrs={"hit": True, "bytes": len(payload)}
+        )
+        latency = max(0.0, _time.time() - now)
+        self._h_job_latency.labels(qclass).observe(latency)
+        try:
+            self.history.record_submit(
+                job_id, query_class=qclass, session_id=session_id,
+                submitted_s=now,
+            )
+            self._job_terminal_history(job, "completed")
+        except Exception:  # noqa: BLE001 — observability, never
+            # serving-critical
+            log.exception("history record failed for %s", job_id)
+        self._close_job_trace(job, "ok")
+        self._retain_job_obs(job)
+        log.info(
+            "job %s served from result cache (%d bytes)", job_id,
+            len(payload),
+        )
+        return job_id
+
     def submit_physical(
         self,
         physical: ExecutionPlan,
         session_id: str,
         trace: dict | None = None,
+        cache_key: object = None,
     ) -> str:
         job_id = generate_job_id()
         if trace is None:
@@ -722,6 +840,7 @@ class SchedulerServer:
             job = JobInfo(job_id=job_id, session_id=session_id)
             job.query_class = qclass
             job.submitted_s = now
+            job.cache_key = cache_key
             if trace is not None:
                 job.trace_id = trace["trace_id"]
                 root = trace["root"]
@@ -1221,6 +1340,10 @@ class SchedulerServer:
         import math
 
         inflight = self.stage_manager.inflight_tasks()
+        # bypassed jobs are invisible to the stage manager but are demand
+        # all the same (docs/serving.md)
+        with self._lock:
+            inflight += len(self._bypass_pending) + len(self._bypass_running)
         if inflight <= 0:
             return 0
         em = self.executor_manager
@@ -1313,6 +1436,18 @@ class SchedulerServer:
             return
         job.max_attempts = cfg.task_max_attempts()
         job.eager = cfg.eager_shuffle()
+        # serving fast path (docs/serving.md): exactly one stage with one
+        # input partition group needs none of the stage state machine —
+        # no dependencies to track, no shuffles to resolve, no
+        # StageFinished to promote. Grant it as one direct task instead
+        # (retries stay bounded by the same task_max_attempts snapshot).
+        if (
+            len(stages) == 1
+            and stages[0].input_partition_count == 1
+            and cfg.single_stage_bypass()
+        ):
+            self._submit_bypass(job, stages[0])
+            return
         deps = _stage_dependencies(stages)
         for stage in stages:
             job.stages[stage.stage_id] = stage
@@ -1919,6 +2054,10 @@ class SchedulerServer:
                 # the other heavy per-job payloads (counters stay)
                 old.rewrite_log.clear()
                 old.aqe_decisions.clear()
+                # cache-served payloads follow the same retention window
+                # (clients poll status within moments of submission; only
+                # the cache itself keeps results long-term)
+                old.result_ipc = b""
                 if old.trace_id:
                     self._traces.pop(old.trace_id, None)
 
@@ -1971,6 +2110,9 @@ class SchedulerServer:
         except Exception:  # noqa: BLE001 — observability, never
             # completion-critical
             log.exception("history record failed for %s", job_id)
+        # serving fast path (docs/serving.md): populate the result cache
+        # from the COMMITTED locations, off-thread
+        self._maybe_cache_result(job)
         # locations are snapshotted on the JobInfo; dropping the stage
         # bookkeeping zeroes the inflight count (KEDA's scale signal) and
         # stops fetch_schedulable_stage from ever seeing this job again
@@ -2044,17 +2186,49 @@ class SchedulerServer:
         return plan_bytes
 
     def next_task(self, executor_id: str) -> pb.TaskDefinition | None:
+        tasks = self.next_tasks(executor_id, 1)
+        return tasks[0] if tasks else None
+
+    def next_tasks(
+        self, executor_id: str, max_n: int
+    ) -> list[pb.TaskDefinition]:
+        """Batched pull-mode handout (docs/serving.md): up to ``max_n``
+        task definitions for one PollWork round-trip. Bypass grants go
+        first (the latency-sensitive small jobs, queued FIFO outside the
+        stage machinery), then stage tasks via ONE atomic batched pick
+        (assign_next_tasks — the pick/mark race stays closed per batch),
+        and only when nothing else was runnable, a single eager-shuffle
+        task (eager consumers soak otherwise-idle slots; granting them a
+        whole batch would starve runnable work arriving mid-poll)."""
+        max_n = max(1, max_n)
+        out: list[pb.TaskDefinition] = []
+        while len(out) < max_n:
+            td = self._next_bypass_task(executor_id)
+            if td is None:
+                break
+            out.append(td)
+        if len(out) < max_n:
+            for picked in self.stage_manager.assign_next_tasks(
+                executor_id, max_n - len(out)
+            ):
+                td = self._task_def_from_pick(picked, eager_pick=False)
+                if td is not None:
+                    out.append(td)
+        if not out:
+            picked = self._pick_eager_task(executor_id)
+            if picked is not None:
+                td = self._task_def_from_pick(picked, eager_pick=True)
+                if td is not None:
+                    out.append(td)
+        return out
+
+    def _task_def_from_pick(
+        self, picked, eager_pick: bool
+    ) -> pb.TaskDefinition | None:
         # atomic pick+mark inside the stage manager: two concurrent
         # PollWork threads previously could both see the same partition
         # PENDING (the second RUNNING mark was silently dropped as an
         # illegal RUNNING->RUNNING hop) and both run the task
-        eager_pick = False
-        picked = self.stage_manager.assign_next_task(executor_id)
-        if picked is None:
-            picked = self._pick_eager_task(executor_id)
-            eager_pick = picked is not None
-        if picked is None:
-            return None
         job_id, stage_id, partition, attempt, events = picked
         for e in events:
             self.event_loop.post(e)
@@ -2130,11 +2304,23 @@ class SchedulerServer:
         if failure is not None:
             self.event_loop.post(failure)
             return None
-        cfg = self._session_config(job.session_id)
-        # queue-wait metering (docs/observability.md): the FIRST task
-        # assignment of a job closes its submit->assignment gap — the
-        # admission/backpressure signal the composite autoscale pressure
-        # and the SLO harness read
+        self._meter_first_assign(job)
+        props = self._task_props(job, stage_id, attempt)
+        return pb.TaskDefinition(
+            task_id=pb.PartitionId(
+                job_id=job_id, stage_id=stage_id, partition_id=partition
+            ),
+            plan=plan_bytes,
+            props=props,
+            session_id=job.session_id,
+        )
+
+    def _meter_first_assign(self, job: JobInfo) -> None:
+        """Queue-wait metering (docs/observability.md): the FIRST task
+        assignment of a job closes its submit->assignment gap — the
+        admission/backpressure signal the composite autoscale pressure
+        and the SLO harness read. Shared by the stage and bypass handout
+        paths so bypassed jobs meter identically."""
         import time as _time
 
         now = _time.time()
@@ -2147,6 +2333,11 @@ class SchedulerServer:
             self._h_queue_wait.labels(job.query_class).observe(wait)
             with self._lock:
                 self._recent_queue_waits.append((now, wait))
+
+    def _task_props(
+        self, job: JobInfo, stage_id: int, attempt: int
+    ) -> list[pb.KeyValuePair]:
+        cfg = self._session_config(job.session_id)
         from ballista_tpu.config import (
             BALLISTA_INTERNAL_QUERY_CLASS,
             BALLISTA_INTERNAL_SPAN_PARENT,
@@ -2184,14 +2375,237 @@ class SchedulerServer:
                     value=self._stage_span_id(job, stage_id),
                 ),
             ]
+        return props
+
+    # -- serving fast path (docs/serving.md) ---------------------------------
+    def _submit_bypass(self, job: JobInfo, stage: QueryStage) -> None:
+        """Register a single-stage job for direct grant: serialize the
+        (already fully resolved — one stage means no placeholders) plan
+        once, queue the job FIFO, and never touch the stage manager.
+        Called from _generate_stages on the event-loop thread."""
+        job_id = job.job_id
+        job.stages[stage.stage_id] = stage
+        job.final_stage_id = stage.stage_id
+        job.bypass = True
+        job.status = "running"
+        plan_bytes = self.codec.physical_to_proto(
+            stage.plan
+        ).SerializeToString()
+        if self.state is not None:
+            self.state.save_stage_plan(job_id, stage.stage_id, stage.plan)
+            self.state.save_job(job)
+        self._open_stage_span(job, stage.stage_id)
+        self._job_event(job, "bypass", attrs={"stage_id": stage.stage_id})
+        with self._lock:
+            job.resolved_plan_bytes[stage.stage_id] = plan_bytes
+            self.obs_bypass_total += 1
+            self._bypass_pending.append(job_id)
+
+    def _next_bypass_task(
+        self, executor_id: str
+    ) -> pb.TaskDefinition | None:
+        """Pop one queued bypass grant. The pending queue only ever holds
+        job ids; torn-down/failed jobs are skipped here rather than
+        scrubbed at teardown (the queue is short-lived and bounded by
+        submission rate)."""
+        job = None
+        with self._lock:
+            while self._bypass_pending:
+                job_id = self._bypass_pending.popleft()
+                j = self.jobs.get(job_id)
+                if j is None or j.status != "running":
+                    continue
+                job = j
+                stage_id = job.final_stage_id
+                plan_bytes = job.resolved_plan_bytes[stage_id]
+                attempt = self._bypass_attempts.get(job_id, 0)
+                self._bypass_running[job_id] = executor_id
+                break
+        if job is None:
+            return None
+        self._meter_first_assign(job)
+        props = self._task_props(job, stage_id, attempt)
         return pb.TaskDefinition(
             task_id=pb.PartitionId(
-                job_id=job_id, stage_id=stage_id, partition_id=partition
+                job_id=job.job_id, stage_id=stage_id, partition_id=0
             ),
             plan=plan_bytes,
             props=props,
             session_id=job.session_id,
         )
+
+    def _apply_bypass_status(
+        self, job: JobInfo, tid: PartitionId, st: pb.TaskStatus, kind: str
+    ) -> None:
+        """Terminal handling for a bypassed job's single task — inline on
+        the status RPC thread (no event-loop hop: bypass exists to cut
+        exactly that latency, and a bypass job has no other events its
+        completion could race)."""
+        if kind == "completed":
+            with self._lock:
+                if job.status != "running":
+                    return  # duplicate report after a terminal state
+                self._bypass_running.pop(job.job_id, None)
+            metas = [
+                ShuffleWritePartitionMeta(
+                    partition_id=int(p.partition_id),
+                    path=p.path,
+                    num_batches=int(p.num_batches),
+                    num_rows=int(p.num_rows),
+                    num_bytes=int(p.num_bytes),
+                    push=bool(p.push),
+                )
+                for p in st.completed.partitions
+            ]
+            self._ingest_task_metrics(
+                tid.job_id, tid.stage_id, tid.partition_id, st
+            )
+            try:
+                self._ingest_task_cost(
+                    tid, "completed", st.completed.executor_id,
+                    st.completed.cost
+                    if st.completed.HasField("cost") else None,
+                )
+            except Exception:  # noqa: BLE001
+                log.exception("task-cost ingest failed for %s", tid)
+            self._finish_bypass_job(job, st.completed.executor_id, metas)
+        elif kind == "failed":
+            error = st.failed.error
+            try:
+                self._ingest_task_cost(
+                    tid, "failed", "",
+                    st.failed.cost if st.failed.HasField("cost") else None,
+                )
+            except Exception:  # noqa: BLE001
+                log.exception("task-cost ingest failed for %s", tid)
+            retry = False
+            with self._lock:
+                if job.status != "running":
+                    return
+                self._bypass_running.pop(job.job_id, None)
+                n = self._bypass_attempts.get(job.job_id, 0) + 1
+                self._bypass_attempts[job.job_id] = n
+                # same bounded-retry contract as the stage machinery:
+                # the job's task_max_attempts snapshot caps attempts
+                retry = error_is_retryable(error) and n < job.max_attempts
+                if retry:
+                    job.total_retries += 1
+                    self._bypass_pending.append(job.job_id)
+            if not retry:
+                self._on_job_failed(
+                    job.job_id,
+                    f"task {tid.job_id}/{tid.stage_id}/"
+                    f"{tid.partition_id} failed: {error}",
+                )
+
+    def _finish_bypass_job(
+        self, job: JobInfo, executor_id: str,
+        metas: list[ShuffleWritePartitionMeta],
+    ) -> None:
+        """Complete a bypassed job with full observability parity: the
+        same locations shape (the client streams the result back through
+        the existing Flight path), latency histogram, terminal history
+        record, trace close, retention enrollment, and result-cache
+        population as _on_job_finished."""
+        host, port = self._executor_endpoint(executor_id)
+        flat = [
+            PartitionLocation(
+                job_id=job.job_id,
+                stage_id=job.final_stage_id,
+                partition=m.partition_id,
+                executor_id=executor_id,
+                host=host,
+                port=port,
+                path=m.path,
+                push=m.push,
+                map_partition=0,
+            )
+            for m in metas
+        ]
+        job.completed_locations = flat
+        job.status = "completed"
+        if job.submitted_s:
+            import time as _time
+
+            self._h_job_latency.labels(job.query_class).observe(
+                max(0.0, _time.time() - job.submitted_s)
+            )
+        if self.state is not None:
+            try:
+                self.state.save_job(job)
+            except Exception:  # noqa: BLE001 — persistence must not
+                # outrank the completion the client is polling for
+                log.exception("persisting bypass job %s failed", job.job_id)
+        self._finish_stage_span(job, job.final_stage_id)
+        self._close_job_trace(job, "ok")
+        self._retain_job_obs(job)
+        try:
+            self._job_terminal_history(job, "completed")
+        except Exception:  # noqa: BLE001
+            log.exception("history record failed for %s", job.job_id)
+        self._maybe_cache_result(job)
+        log.info(
+            "job %s completed via bypass (%d partitions)",
+            job.job_id, len(flat),
+        )
+
+    def _maybe_cache_result(self, job: JobInfo) -> None:
+        """Kick off background result-cache population for a COMPLETED
+        job. Off-thread: it re-reads the committed partitions (file or
+        Flight), and the callers hold the completion path."""
+        if not self.result_cache.enabled or job.cache_key is None:
+            return
+        if not job.completed_locations:
+            return  # nothing committed to re-read; never cache a guess
+        # fire-and-forget by design: one short-lived thread per
+        # completed job, observed through result_cache.stats() (and the
+        # resource witness when enabled), not a join
+        t = threading.Thread(  # lifelint: transfer=job-completion-scoped
+            target=self._populate_result_cache,
+            args=(job,),
+            daemon=True,
+            name=f"result-cache-{job.job_id}",
+        )
+        t.start()
+
+    def _populate_result_cache(self, job: JobInfo) -> None:
+        """Fetch the job's committed final-stage partitions through the
+        SAME reader path the client uses and store them as one Arrow IPC
+        stream. Running strictly after the job completed is the
+        committed-only guarantee: a task killed mid-run never reported
+        partitions, so nothing partial is reachable from
+        completed_locations; any fetch failure (executor died in the
+        window) stores nothing."""
+        try:
+            import pyarrow as pa
+
+            from ballista_tpu.executor.reader import fetch_partition_table
+            from ballista_tpu.scheduler.result_cache import table_to_ipc
+
+            # the client concatenates in completed_locations order —
+            # matching it keeps a cache-served result bit-exact with a
+            # freshly fetched one
+            tables = [
+                fetch_partition_table(loc)
+                for loc in job.completed_locations
+            ]
+            table = (
+                pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+            )
+            payload = table_to_ipc(table)
+            stored = self.result_cache.put(
+                job.cache_key, payload, {"query_class": job.query_class}
+            )
+            if stored:
+                self._job_event(
+                    job, "cache",
+                    attrs={"stored": True, "bytes": len(payload)},
+                )
+        except Exception:  # noqa: BLE001 — the cache is an optimization;
+            # population failure must never surface to the finished job
+            log.exception(
+                "result-cache population failed for %s", job.job_id
+            )
 
     # -- task handout (push mode; ref scheduler_server/event_loop.rs:35-169
     # + state/task_scheduler.rs:53-211) --------------------------------------
@@ -2347,6 +2761,14 @@ class SchedulerServer:
                 st.task_id.job_id, st.task_id.stage_id, st.task_id.partition_id
             )
             kind = st.WhichOneof("status")
+            # bypassed jobs (docs/serving.md) have no stage bookkeeping:
+            # their single task's terminal status completes/fails the job
+            # inline instead of flowing through the stage state machine
+            bjob = self._get_job(tid.job_id)
+            if bjob is not None and bjob.bypass:
+                if kind in ("completed", "failed"):
+                    self._apply_bypass_status(bjob, tid, st, kind)
+                continue
             if kind == "completed":
                 metas = [
                     ShuffleWritePartitionMeta(
@@ -2507,7 +2929,11 @@ class SchedulerServer:
             completed=pb.CompletedJob(
                 partition_location=[
                     loc_to_proto(l) for l in job.completed_locations
-                ]
+                ],
+                # result-cache hits (docs/serving.md): the payload rides
+                # the status reply and the client short-circuits the
+                # partition fetch entirely
+                result_ipc=job.result_ipc,
             )
         )
 
@@ -2579,9 +3005,23 @@ class SchedulerGrpcServicer:
         self.s.apply_task_statuses(list(request.task_status))
         result = pb.PollWorkResult()
         if request.can_accept_task:
-            task = self.s.next_task(meta.id)
-            if task is not None:
-                result.task.CopyFrom(task)
+            # batched grants (docs/serving.md): an executor advertising
+            # free_slots gets up to min(free_slots, task_grant_batch)
+            # tasks per round-trip; free_slots == 0 is a pre-batching
+            # executor, which gets at most one. The batch knob is read
+            # from the SCHEDULER's config — PollWork carries no session.
+            max_n = 1
+            if request.free_slots > 0:
+                max_n = min(
+                    int(request.free_slots),
+                    self.s.config.task_grant_batch(),
+                )
+            tasks = self.s.next_tasks(meta.id, max_n)
+            if tasks:
+                result.tasks.extend(tasks)
+                # mirror the first grant into the singular field so a
+                # pre-batching executor still makes progress
+                result.task.CopyFrom(tasks[0])
         return result
 
     def RegisterExecutor(self, request, context):

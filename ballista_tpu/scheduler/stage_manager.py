@@ -295,6 +295,24 @@ class StageManager:
             attempt = self.task_attempt(job_id, stage_id, partition)
             return job_id, stage_id, partition, attempt, events
 
+    def assign_next_tasks(
+        self, executor_id: str = "", max_n: int = 1
+    ) -> list[tuple[str, int, int, int, list["StageEvent"]]]:
+        """Batched :meth:`assign_next_task` (docs/serving.md): up to
+        ``max_n`` picks inside ONE critical section, so a single PollWork
+        round-trip can carry a full grant batch without re-racing the
+        pick/mark window per task. Picks may span stages/jobs — each
+        iteration re-fetches the schedulable stage, so a stage drained
+        mid-batch simply hands the remaining slots to the next one."""
+        out: list[tuple[str, int, int, int, list["StageEvent"]]] = []
+        with self._lock:
+            for _ in range(max(1, max_n)):
+                got = self.assign_next_task(executor_id)
+                if got is None:
+                    break
+                out.append(got)
+        return out
+
     def assign_next_eager_task(
         self, executor_id: str, eager_jobs: set[str]
     ) -> tuple[str, int, int, int, list["StageEvent"]] | None:

@@ -1,0 +1,197 @@
+"""Result cache under chaos (docs/serving.md committed-only contract):
+a two-executor cluster with the result cache ON runs TPC-H q3 while one
+executor is killed mid-query (shuffle files deleted). The cache may only
+ever hold the COMMITTED result — population happens after JobFinished by
+re-reading the final committed partitions — so the entry stored after
+lineage recovery, and the hit served from it, must be bit-exact against
+a clean fault-free run. The resource witness rides the same run: zero
+leaked resources, cache thread included.
+
+Marked ``chaos``: the witness env is enabled in the SUBPROCESS only.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from tests.conftest import CPU_MESH_ENV
+
+SCRIPT = r"""
+import pathlib
+import threading
+import time
+
+from ballista_tpu.analysis import replay, reswitness
+from ballista_tpu.client.context import BallistaContext
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.scheduler.result_cache import ipc_to_table
+from ballista_tpu.tpch import gen_all
+
+assert reswitness.enabled(), "BALLISTA_RESOURCE_WITNESS must reach here"
+replay.enable()
+
+data = gen_all(scale=0.01)
+sql = pathlib.Path("benchmarks/queries/q3.sql").read_text()
+
+
+def make_ctx():
+    cfg = (
+        BallistaConfig()
+        .with_setting("ballista.shuffle.partitions", "2")
+        .with_setting("ballista.tpu.result_cache_mb", "16")
+        .with_setting("ballista.tpu.fetch_backoff_ms", "10")
+        # force real shuffle stages: no shuffle output to lose means no
+        # mid-query kill has anything to disturb
+        .with_setting("ballista.tpu.collective_shuffle", "false")
+    )
+    ctx = BallistaContext.standalone(
+        cfg, n_executors=2, executor_timeout_s=2.0,
+        expiry_check_interval_s=0.5,
+    )
+    for name, t in data.items():
+        ctx.register_table(name, t)
+    return ctx
+
+
+# ---- clean pass: fault-free reference result -------------------------------
+clean_ctx = make_ctx()
+clean = clean_ctx.sql(sql).collect()
+assert clean.num_rows > 0
+clean_ctx.close()
+print("CLEAN-OK", clean.num_rows)
+
+# ---- chaos pass: kill an executor mid-q3 with the cache on -----------------
+ctx = make_ctx()
+cluster = ctx._standalone_cluster
+sched = cluster.scheduler
+
+
+def attempt_kill_mid_query():
+    result = {}
+
+    def drive():
+        result["q3"] = ctx.sql(sql).collect()
+
+    t3 = threading.Thread(target=drive)
+    t3.start()
+    victim_id = None
+    deadline = time.time() + 120
+    while time.time() < deadline and victim_id is None:
+        for (job_id, stage_id), stage in list(
+            sched.stage_manager._stages.items()
+        ):
+            for task in stage.tasks:
+                if task.state.value == "completed" and task.executor_id:
+                    victim_id = task.executor_id
+                    break
+            if victim_id:
+                break
+        time.sleep(0.005)
+    job = list(sched.jobs.values())[-1]
+    if victim_id is None or job.status != "running":
+        t3.join(timeout=300)
+        return None  # query outran the kill window — retry
+    # the kill lands while the job is RUNNING: nothing may be in the
+    # cache for it yet (committed-only — population is post-terminal)
+    assert sched.result_cache.stats()["entries"] == 0, (
+        "cache held an entry for a still-running job"
+    )
+    victim_idx = next(
+        i for i, h in enumerate(cluster.executors)
+        if h.executor.executor_id == victim_id
+    )
+    cluster.kill_executor(victim_idx, lose_shuffle=True)
+    cluster.add_executor()
+    t3.join(timeout=300)
+    assert not t3.is_alive(), "q3 wedged after executor kill"
+    assert job.status == "completed", (job.status, job.error)
+    return job, result["q3"]
+
+
+got = None
+for _round in range(3):
+    got = attempt_kill_mid_query()
+    if got is not None:
+        break
+    # the cold run outran the kill; drop its cache entry so the next
+    # round re-executes instead of hitting
+    sched.result_cache.clear()
+assert got is not None, "kill never landed mid-query in 3 rounds"
+job, chaos_result = got
+assert job.total_retries + job.total_recomputes >= 1, (
+    "kill left no recovery trace"
+)
+print("KILL-OK", job.total_retries, job.total_recomputes)
+
+# ---- the committed-only contract -------------------------------------------
+# population re-reads the final COMMITTED partitions after JobFinished;
+# wait for the async store, then compare the raw cached payload — not a
+# re-execution — against the clean fault-free run
+deadline = time.time() + 30
+while time.time() < deadline and sched.result_cache.stats()["entries"] < 1:
+    time.sleep(0.05)
+stats = sched.result_cache.stats()
+assert stats["entries"] >= 1, stats
+with sched.result_cache._lock:
+    payloads = [p for p, _m in sched.result_cache._entries.values()]
+assert len(payloads) == 1
+cached = ipc_to_table(payloads[0])
+
+
+def canon(t):
+    import pandas as pd
+    df = t.to_pandas()
+    return df.sort_values(list(df.columns)).reset_index(drop=True)
+
+
+import pandas as pd
+pd.testing.assert_frame_equal(canon(cached), canon(clean), check_exact=True)
+pd.testing.assert_frame_equal(
+    canon(chaos_result), canon(clean), check_exact=True
+)
+print("COMMITTED-BIT-EXACT-OK")
+
+# ---- a hit after chaos serves the same bytes -------------------------------
+hit = ctx.sql(sql).collect()
+assert sched.result_cache.stats()["hits"] >= 1, sched.result_cache.stats()
+pd.testing.assert_frame_equal(canon(hit), canon(clean), check_exact=True)
+print("HIT-OK")
+
+ctx.close()
+from ballista_tpu.client.flight import close_pool
+close_pool()
+
+deadline = time.time() + 30
+while reswitness.live() and time.time() < deadline:
+    time.sleep(0.1)
+reswitness.assert_drained()
+replay.assert_clean()
+print("CACHE-CHAOS-OK")
+"""
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # ~70s wall (2 cluster boots + mid-query kill retry
+# rounds + expiry waits) — over the tier-1 budget, runs in the slow tier
+def test_cache_only_holds_committed_results_under_executor_kill():
+    proc = subprocess.run(
+        [sys.executable, "-c", SCRIPT],
+        env={**CPU_MESH_ENV, "BALLISTA_RESOURCE_WITNESS": "1"},
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    for marker in (
+        "CLEAN-OK", "KILL-OK", "COMMITTED-BIT-EXACT-OK", "HIT-OK",
+        "CACHE-CHAOS-OK",
+    ):
+        assert marker in proc.stdout, (
+            f"missing {marker}\nstdout:\n{proc.stdout}\n"
+            f"stderr:\n{proc.stderr[-4000:]}"
+        )

@@ -1,0 +1,287 @@
+"""Plan-fingerprint result cache (scheduler/result_cache.py,
+docs/serving.md).
+
+Unit coverage of the keying rules (uncacheable submissions return a
+None key) and the bytes-bounded LRU (deterministic eviction order,
+oversize rejection counted, disabled cache no-ops), plus standalone-
+cluster acceptance: a repeated identical query is served from the
+scheduler's cache without executor involvement, bit-exactly;
+re-registration (the engine's append) invalidates by key; a scheduler
+restart starts with an empty cache and never serves a recovered job's
+payload.
+"""
+
+import time
+
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.scheduler.result_cache import (
+    ResultCache,
+    ipc_to_table,
+    result_cache_key,
+    table_to_ipc,
+)
+
+# ---------------------------------------------------------------------------
+# unit: LRU mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_lru_eviction_order_is_deterministic():
+    """Eviction pops strictly least-recently-used: insertion order,
+    reordered only by get()'s recency touch — no hash-seed iteration
+    anywhere (detlint discipline for the eviction path)."""
+    c = ResultCache(capacity_bytes=100)
+    # entry cap is capacity//4 = 25 bytes; use 20-byte payloads
+    p = b"x" * 20
+    for k in ("a", "b", "c", "d", "e"):
+        assert c.put((k,), p)
+    # 5*20=100 fits exactly; touching "a" then adding "f" must evict "b"
+    assert c.get(("a",)) is not None
+    assert c.put(("f",), p)
+    assert c.get(("b",)) is None  # evicted (LRU after the "a" touch)
+    assert c.get(("a",)) is not None  # survived: recency respected
+    s = c.stats()
+    assert s["evictions"] == 1
+    assert s["entries"] == 5
+    assert s["bytes"] == 100
+
+
+def test_oversize_rejected_and_counted():
+    c = ResultCache(capacity_bytes=100)
+    assert not c.put(("big",), b"y" * 26)  # > capacity//4
+    assert c.stats()["rejected_oversize"] == 1
+    assert c.stats()["entries"] == 0
+
+
+def test_disabled_cache_noops():
+    c = ResultCache(capacity_bytes=0)
+    assert not c.enabled
+    assert not c.put(("k",), b"v")
+    assert c.get(("k",)) is None
+    assert c.stats()["hits"] == 0 and c.stats()["misses"] == 0
+
+
+def test_none_key_counts_as_miss():
+    """Uncacheable submissions (None keys) are counted misses so the
+    reported hit ratio stays honest about them."""
+    c = ResultCache(capacity_bytes=100)
+    assert c.get(None) is None
+    assert c.stats()["misses"] == 1
+
+
+def test_put_replaces_and_rebalances_bytes():
+    c = ResultCache(capacity_bytes=100)
+    c.put(("k",), b"x" * 10)
+    c.put(("k",), b"y" * 20)
+    s = c.stats()
+    assert s["entries"] == 1 and s["bytes"] == 20
+    payload, _meta = c.get(("k",))
+    assert payload == b"y" * 20
+
+
+def test_ipc_roundtrip():
+    t = pa.table({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    assert ipc_to_table(table_to_ipc(t)).equals(t)
+
+
+# ---------------------------------------------------------------------------
+# unit: keying rules
+# ---------------------------------------------------------------------------
+
+
+def _local_ctx():
+    from ballista_tpu.exec.context import TpuContext
+
+    ctx = TpuContext()
+    ctx.register_table("t", pa.table({"a": [1, 2, 3]}))
+    return ctx
+
+
+def test_key_is_stable_and_version_sensitive():
+    from ballista_tpu.plan.optimizer import optimize
+
+    ctx = _local_ctx()
+    cfg = BallistaConfig()
+    plan = optimize(ctx.sql_to_logical("select a from t where a > 1"))
+    k1 = result_cache_key(plan, cfg, ctx)
+    k2 = result_cache_key(plan, cfg, ctx)
+    assert k1 is not None and k1 == k2
+    # settings are part of the identity: sessions never collide
+    k3 = result_cache_key(
+        plan, cfg.with_setting("ballista.shuffle.partitions", "7"), ctx
+    )
+    assert k3 != k1
+    # re-registration (the engine's append) changes _data_version
+    ctx.register_table("t", pa.table({"a": [1, 2, 3, 4]}))
+    assert result_cache_key(plan, cfg, ctx) != k1
+
+
+def test_key_none_for_system_scans_and_missing_provider():
+    from ballista_tpu.plan.optimizer import optimize
+
+    ctx = _local_ctx()
+    cfg = BallistaConfig()
+    sys_plan = optimize(
+        ctx.sql_to_logical("select * from system.queries")
+    )
+    assert result_cache_key(sys_plan, cfg, ctx) is None
+    user_plan = optimize(ctx.sql_to_logical("select a from t"))
+
+    class NoVersion:
+        pass
+
+    assert result_cache_key(user_plan, cfg, NoVersion()) is None
+
+
+# ---------------------------------------------------------------------------
+# acceptance: standalone cluster
+# ---------------------------------------------------------------------------
+
+
+def _standalone(data, **settings):
+    from ballista_tpu.client.context import BallistaContext
+
+    cfg = (
+        BallistaConfig()
+        .with_setting("ballista.shuffle.partitions", "2")
+        .with_setting("ballista.tpu.result_cache_mb", "16")
+    )
+    for k, v in settings.items():
+        cfg = cfg.with_setting(k.replace("__", "."), v)
+    ctx = BallistaContext.standalone(cfg)
+    for name, t in data.items():
+        ctx.register_table(name, t)
+    return ctx
+
+
+def _wait_entries(sched, n, timeout=10.0):
+    """Cache population is asynchronous (a background re-read of the
+    committed partitions after JobFinished) — wait for it."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if sched.result_cache.stats()["entries"] >= n:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"cache never reached {n} entries: {sched.result_cache.stats()}"
+    )
+
+
+def test_cache_hit_serves_without_executor_bit_exact():
+    t = pa.table(
+        {"k": [i % 5 for i in range(1000)],
+         "v": [float(i) for i in range(1000)]}
+    )
+    ctx = _standalone({"t": t})
+    sched = ctx._standalone_cluster.scheduler
+    sql = "select k, sum(v) as s from t group by k order by k"
+    try:
+        cold = ctx.sql(sql).collect()
+        _wait_entries(sched, 1)
+        with sched._lock:
+            jobs_before = len(sched.jobs)
+        stages_before = sched.stage_manager.inflight_tasks()
+        hit = ctx.sql(sql).collect()
+        assert hit.equals(cold), "cache hit must be bit-exact"
+        s = sched.result_cache.stats()
+        assert s["hits"] >= 1, s
+        # the hit minted a job (observability parity) but scheduled
+        # nothing: no stages, no tasks, payload inline on the status
+        with sched._lock:
+            hit_job = max(
+                sched.jobs.values(), key=lambda j: j.submitted_s
+            )
+            assert len(sched.jobs) == jobs_before + 1
+        assert hit_job.status == "completed"
+        assert hit_job.result_ipc
+        assert not hit_job.stages
+        assert sched.stage_manager.inflight_tasks() == stages_before
+        # the cache span marks the hit in the job's event record
+        # (observability: a hit is visible, not silent)
+        assert hit_job.query_class not in ("", None)
+        # history parity: the hit job is in the persistent query log
+        assert any(
+            r["job_id"] == hit_job.job_id for r in sched.history.jobs()
+        )
+    finally:
+        ctx.close()
+
+
+def test_append_and_reregister_invalidate_by_key():
+    t = pa.table({"a": [1, 2, 3], "b": [1.0, 2.0, 3.0]})
+    ctx = _standalone({"t": t})
+    sched = ctx._standalone_cluster.scheduler
+    sql = "select sum(a) as s from t"
+    try:
+        r1 = ctx.sql(sql).collect()
+        assert r1.column("s")[0].as_py() == 6
+        _wait_entries(sched, 1)
+        # append (re-register with extra rows): next submission MISSES
+        # and returns the fresh result
+        t2 = pa.concat_tables([t, pa.table({"a": [10], "b": [10.0]})])
+        ctx.register_table("t", t2)
+        r2 = ctx.sql(sql).collect()
+        assert r2.column("s")[0].as_py() == 16
+        s = sched.result_cache.stats()
+        assert s["hits"] == 0 and s["misses"] >= 2, s
+        # the old entry is dead BY KEY — re-registering the original
+        # table object still misses (id() changed => version changed)
+        ctx.register_table("t", pa.table(t.to_pydict()))
+        r3 = ctx.sql(sql).collect()
+        assert r3.column("s")[0].as_py() == 6
+        assert sched.result_cache.stats()["hits"] == 0
+    finally:
+        ctx.close()
+
+
+def test_system_tables_never_cached():
+    t = pa.table({"a": [1, 2, 3]})
+    ctx = _standalone({"t": t})
+    sched = ctx._standalone_cluster.scheduler
+    try:
+        ctx.sql("select a from t").collect()
+        _wait_entries(sched, 1)
+        before = sched.result_cache.stats()["entries"]
+        ctx.sql("select * from system.queries").collect()
+        ctx.sql("select * from system.queries").collect()
+        # system scans must neither hit nor store (they serve the rows
+        # as of THIS query)
+        assert sched.result_cache.stats()["entries"] == before
+        assert sched.result_cache.stats()["hits"] == 0
+    finally:
+        ctx.close()
+
+
+def test_scheduler_restart_drops_cache(tmp_path):
+    """The cache is in-memory only: a recovered scheduler starts empty
+    and a recovered completed job carries no inline payload (clients
+    re-fetch the durable partitions instead of a stale cache blob)."""
+    from ballista_tpu.scheduler.persistent_state import (
+        PersistentSchedulerState,
+    )
+    from ballista_tpu.scheduler.server import JobInfo, SchedulerServer
+    from ballista_tpu.scheduler.state_backend import SqliteBackend
+
+    backend = SqliteBackend(str(tmp_path / "s.db"))
+    st = PersistentSchedulerState(backend, "default", None)
+    job = JobInfo(job_id="abc9999", session_id="s1", status="completed")
+    st.save_job(job)
+    st.save_session("s1", {})
+
+    cfg = BallistaConfig().with_setting(
+        "ballista.tpu.result_cache_mb", "16"
+    )
+    recovered = SchedulerServer(
+        provider=None, state_backend=backend, config=cfg
+    )
+    try:
+        assert recovered.result_cache.enabled
+        assert recovered.result_cache.stats()["entries"] == 0
+        stp = recovered.job_status_proto("abc9999")
+        assert stp.WhichOneof("status") == "completed"
+        assert stp.completed.result_ipc == b""
+    finally:
+        recovered.shutdown()
