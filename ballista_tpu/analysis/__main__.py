@@ -1,6 +1,6 @@
 """Combined static-analysis gate: ``python -m ballista_tpu.analysis``.
 
-Runs all ten analyzers with one exit code and a per-analyzer summary
+Runs all eleven analyzers with one exit code and a per-analyzer summary
 line — the single command CI (and a developer pre-push) needs:
 
 - **planlint** — the plan verifier over the TPC-H q1-q22 corpus
@@ -37,6 +37,14 @@ line — the single command CI (and a developer pre-push) needs:
   data plane, and completion-order-dependent reductions/merges; its
   runtime counterpart is the replay witness
   (:mod:`ballista_tpu.analysis.replay`, ``BALLISTA_REPLAY_WITNESS=1``).
+- **stalelint** — cache-coherence lint over the declared cache registry
+  (analysis/cachereg.py): undeclared cache-shaped state,
+  version-source mutators that drop a declared invalidation call,
+  reads of snapshot-class learned state outside the job-snapshot seam
+  (the q15 bug shape), and speculative-cache writes outside the
+  validation seam; its runtime counterpart is the staleness witness
+  (:mod:`ballista_tpu.analysis.stalewitness`,
+  ``BALLISTA_CACHE_WITNESS=1``).
 
 Suppression budgets for every AST analyzer live in ONE ledger
 (:mod:`ballista_tpu.analysis.budget`) enforced here and pinned by a
@@ -67,6 +75,7 @@ import time
 ANALYZERS = (
     "planlint", "serde-audit", "jaxlint", "racelint", "compile-vocab",
     "lifelint", "proto-drift", "config-registry", "eqlint", "detlint",
+    "stalelint",
 )
 
 # analyzers sharing one worker under parallel execution: planlint and
@@ -267,6 +276,26 @@ def run_detlint() -> tuple[bool, str]:
     )
 
 
+def run_stalelint() -> tuple[bool, str]:
+    from ballista_tpu.analysis import budget, cachereg, stalelint
+
+    problems = cachereg.verify_anchors()
+    docs = cachereg.docs_in_sync()
+    if docs:
+        problems.append(docs)
+    diags = stalelint.lint_paths()
+    sup = stalelint.suppression_count()
+    if problems or diags:
+        return False, "\n".join(problems + [str(d) for d in diags])
+    over = budget.check("stalelint", sup)
+    if over:
+        return False, over
+    return True, (
+        f"0 findings, {sup} suppressions, {len(cachereg.CACHES)} declared "
+        f"caches / {len(cachereg.CONTRACTS)} invalidation contracts"
+    )
+
+
 def _runners(queries):
     """Resolved at call time from module attributes, so tests can
     monkeypatch individual runners."""
@@ -281,6 +310,7 @@ def _runners(queries):
         "config-registry": run_config_registry,
         "eqlint": run_eqlint,
         "detlint": run_detlint,
+        "stalelint": run_stalelint,
     }
 
 

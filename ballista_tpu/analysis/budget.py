@@ -24,6 +24,7 @@ BUDGETS: dict[str, int] = {
     "lifelint": 5,
     "eqlint": 5,
     "detlint": 5,
+    "stalelint": 5,
 }
 
 
@@ -52,6 +53,7 @@ def ledger() -> dict[str, dict[str, int]]:
         jaxlint,
         lifelint,
         racelint,
+        stalelint,
     )
 
     counts = {
@@ -60,6 +62,7 @@ def ledger() -> dict[str, dict[str, int]]:
         "lifelint": lifelint.suppression_count(),
         "eqlint": eqlint.suppression_count(),
         "detlint": detlint.suppression_count(),
+        "stalelint": stalelint.suppression_count(),
     }
     assert set(counts) == set(BUDGETS), (
         "budget ledger and analyzer set drifted apart"

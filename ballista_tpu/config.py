@@ -238,6 +238,20 @@ ENV_REGISTRY: tuple[EnvEntry, ...] = (
         "docs/fault_tolerance.md",
     ),
     EnvEntry(
+        "BALLISTA_CACHE_WITNESS", "0|1", "0",
+        "Runtime cache-staleness witness: sampled cache hits are "
+        "re-derived fresh and must hash-match what was served; a "
+        "mismatch is a recorded stale hit (analysis/stalewitness.py)",
+        "docs/analysis.md",
+    ),
+    EnvEntry(
+        "BALLISTA_CACHE_WITNESS_SAMPLE", "float 0..1", "1",
+        "Fraction of cache hits the staleness witness re-derives "
+        "(deterministic per-cache stride, no RNG); 1 checks every hit, "
+        "0.25 every fourth",
+        "docs/analysis.md",
+    ),
+    EnvEntry(
         "BALLISTA_AQE", "0|1", "",
         "Process-wide adaptive-query-execution override: 0/off forces "
         "the AQE policy off regardless of session config (the ops "

@@ -288,12 +288,56 @@ import threading as _threading  # noqa: E402
 
 _PROFILER_LOCK = _threading.Lock()
 
+# Bound for a long-lived plan-strategy cache (executor lifetime spans its
+# whole job history; parameterized query streams mint fresh keys forever).
+PLAN_CACHE_MAX_ENTRIES = 4096
+
+# Keys eviction must never remove: the shared HBM tally for instance-held
+# join build tables is an accounting cell, not a learned strategy.
+_PLAN_CACHE_STICKY = ("__build_cache_bytes__",)
+
+
+def evict_plan_cache(
+    plan_cache: dict,
+    pinned=(),
+    max_entries: int = PLAN_CACHE_MAX_ENTRIES,
+) -> int:
+    """Bound ``plan_cache`` by evicting oldest-first (dict insertion
+    order), down to half of ``max_entries`` so eviction amortizes instead
+    of firing per insert. ``pinned`` keys survive: a task running against
+    a job snapshot must not lose the entries that snapshot was taken
+    from mid-attempt (the commit-back ``update`` would resurrect them
+    anyway, but the flush/resurrect churn defeats the learned-strategy
+    warm start). Returns the number of entries evicted; meters
+    ``plan_cache_flush`` / ``plan_cache_evicted`` so soak runs can see
+    cache pressure instead of silent drops."""
+    if len(plan_cache) <= max_entries:
+        return 0
+    keep = set(pinned)
+    keep.update(_PLAN_CACHE_STICKY)
+    target = max_entries // 2
+    evicted = 0
+    for k in list(plan_cache):
+        if len(plan_cache) <= target:
+            break
+        if k in keep:
+            continue
+        del plan_cache[k]
+        evicted += 1
+    if evicted:
+        from ballista_tpu.compilecache import metrics
+
+        metrics.add("plan_cache_flush")
+        metrics.add("plan_cache_evicted", evicted)
+    return evicted
+
 
 def run_with_capacity_retry(
     config: BallistaConfig,
     fn,
     hint: dict | None = None,
     plan_cache: dict | None = None,
+    pinned_cache_keys=(),
     **ctx_fields,
 ):
     """Centralized execution driver: build a TaskContext, run ``fn(ctx)``,
@@ -313,10 +357,11 @@ def run_with_capacity_retry(
     override: int | None = (hint or {}).get("agg_capacity")
     if override is not None and override <= config.agg_capacity():
         override = None
-    if plan_cache is not None and len(plan_cache) > 4096:
-        # bound a long-lived executor's cache across its job history; a
-        # cleared cache only costs the next run one cold strategy sync
-        plan_cache.clear()
+    if plan_cache is not None:
+        # bound a long-lived executor's cache across its job history —
+        # oldest-first, never the entries the current job's snapshot is
+        # pinned to (``pinned_cache_keys``)
+        evict_plan_cache(plan_cache, pinned=pinned_cache_keys)
     spec_misses = 0
     while True:
         ctx = TaskContext(

@@ -198,6 +198,28 @@ class TpuContext(Catalog, TableProvider):
         self._plan_cache.clear()
         self._physical_cache.clear()
 
+    def append_table(self, name: str, table: pa.Table) -> None:
+        """Micro-batch append onto a registered MEMORY table (ROADMAP
+        streaming ingest). Routes through :meth:`register_table` so the
+        append inherits its invalidation contract verbatim — plan caches
+        cleared, and ``_data_version()`` flips because the combined
+        table is a new object with a new row count (stalelint's
+        ``registered-data-append`` contract pins this routing)."""
+        reg = self.tables.get(name)
+        existing = reg.kw.get("table") if reg is not None else None
+        if existing is None:
+            raise PlanError(
+                f"append_table: {name!r} is not a registered memory "
+                "table (file-backed tables version by mtime; rewrite "
+                "the file instead)"
+            )
+        if table.schema != existing.schema:
+            raise PlanError(
+                f"append_table: schema mismatch for {name!r}"
+            )
+        combined = pa.concat_tables([existing, table]).combine_chunks()
+        self.register_table(name, combined)
+
     def deregister_table(self, name: str) -> None:
         self.tables.pop(name, None)
         self._plan_cache.clear()
@@ -453,6 +475,34 @@ class TpuContext(Catalog, TableProvider):
                    self._data_version())
             cached = self._physical_cache.get(key)
             if cached is not None:
+                from ballista_tpu.analysis import stalewitness
+
+                if stalewitness.enabled() and stalewitness.should_sample(
+                    "physical_plan_cache"
+                ):
+                    # staleness witness: re-plan fresh and compare the
+                    # structural renders — a cached operator tree that
+                    # no longer matches what the planner would produce
+                    # for this (plan, settings, data-version) key is a
+                    # stale hit
+                    import hashlib
+
+                    fresh = PhysicalPlanner(
+                        self,
+                        self.config.default_shuffle_partitions(),
+                        mesh_runtime=self.mesh_runtime(),
+                    ).plan(optimized)
+                    stalewitness.check(
+                        "physical_plan_cache",
+                        key[0][:16],
+                        hashlib.sha256(
+                            cached.display().encode()
+                        ).hexdigest(),
+                        hashlib.sha256(
+                            fresh.display().encode()
+                        ).hexdigest(),
+                        version=key[2],
+                    )
                 # Metrics stay per-query, as with a fresh plan. (The
                 # returned instance is SHARED across identical queries:
                 # a caller holding it across another run of the same

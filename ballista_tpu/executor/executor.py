@@ -366,6 +366,10 @@ class Executor:
                 attempt,
                 hint=self._capacity_hint,
                 plan_cache=attempt_cache,
+                # the snapshot's own keys are what this attempt warms
+                # from — eviction at the bound must take newer-job
+                # entries first, never the working set mid-attempt
+                pinned_cache_keys=frozenset(attempt_cache),
                 # plan instances are decoded fresh per task: instance-held
                 # build caches would die with the task while charging the
                 # shared HBM tally (see TaskContext.cache_builds)
@@ -388,6 +392,12 @@ class Executor:
             ("class",),
         ).labels(query_class).observe(time.perf_counter() - run_t0)
         self._plan_cache.update(attempt_cache)
+        # commit-back only ever ADDS, so the executor-lifetime cache needs
+        # its own bound; job snapshots are independent copies, so nothing
+        # running is pinned to these entries
+        from ballista_tpu.exec.base import evict_plan_cache
+
+        evict_plan_cache(self._plan_cache)
         self._hints.save_if_changed(self._capacity_hint, self._plan_cache)
         from ballista_tpu.analysis import replay
 

@@ -302,6 +302,7 @@ def scheduler_families(server) -> list[tuple]:
     families.extend(_span_drop_families())
     families.extend(server.hists.families())
     families.extend(_reswitness_families())
+    families.extend(_cache_witness_families())
     return families
 
 
@@ -338,6 +339,7 @@ def executor_families() -> list[tuple]:
     # the same observations also ship home as deltas on poll/heartbeat
     families.extend(obs_hist.REGISTRY.families())
     families.extend(_reswitness_families())
+    families.extend(_cache_witness_families())
     return families
 
 
@@ -355,6 +357,26 @@ def _reswitness_families() -> list[tuple]:
         ("ballista_live_resources", "gauge",
          "Live witnessed resources by kind (analysis/reswitness.py)",
          [({"kind": k}, v) for k, v in sorted(counts.items())] or [({}, 0)])
+    ]
+
+
+def _cache_witness_families() -> list[tuple]:
+    """Staleness-witness check outcomes when the cache witness is on
+    (BALLISTA_CACHE_WITNESS=1) — empty otherwise. A scrape seeing any
+    ``outcome="stale"`` sample has caught a coherence violation live."""
+    from ballista_tpu.analysis import stalewitness
+
+    if not stalewitness.enabled():
+        return []
+    samples = [
+        ({"cache": cache, "outcome": outcome}, n)
+        for (cache, outcome), n in sorted(stalewitness.counters().items())
+    ]
+    return [
+        ("ballista_cache_witness_checks_total", "counter",
+         "Cache staleness witness checks by cache and outcome "
+         "(analysis/stalewitness.py)",
+         samples or [({}, 0)])
     ]
 
 

@@ -691,9 +691,32 @@ class SchedulerServer:
                 cache_key = result_cache_key(optimized, cfg, self.provider)
                 entry = self.result_cache.get(cache_key)
                 if entry is not None:
-                    return self._serve_cached_result(
-                        entry, session_id, trace=tctx
-                    )
+                    from ballista_tpu.analysis import stalewitness
+
+                    if stalewitness.enabled() and stalewitness.should_sample(
+                        "result_cache"
+                    ):
+                        # staleness witness (docs/analysis.md): demote
+                        # this sampled hit to a miss — the job runs
+                        # fresh through the full stage machinery, and
+                        # the committed repopulation must hash-match
+                        # what this hit WOULD have served
+                        # (_populate_result_cache resolves the pending
+                        # expectation)
+                        from ballista_tpu.analysis import replay
+                        from ballista_tpu.scheduler.result_cache import (
+                            ipc_to_table,
+                        )
+
+                        stalewitness.expect(
+                            "result_cache", cache_key,
+                            replay.canonical_hash(ipc_to_table(entry[0])),
+                            payload=entry[0],
+                        )
+                    else:
+                        return self._serve_cached_result(
+                            entry, session_id, trace=tctx
+                        )
             if verify:
                 # submission-time gate: reject inconsistent plans with a
                 # typed PlanVerificationError (naming the operator path)
@@ -2593,6 +2616,19 @@ class SchedulerServer:
                 pa.concat_tables(tables) if len(tables) > 1 else tables[0]
             )
             payload = table_to_ipc(table)
+            from ballista_tpu.analysis import stalewitness
+
+            if stalewitness.enabled():
+                # staleness witness: this fresh committed result is the
+                # re-derivation for any demoted hit on the same key —
+                # the served-payload hash registered at the demotion
+                # must match it (no pending expectation -> no-op)
+                from ballista_tpu.analysis import replay
+
+                stalewitness.resolve(
+                    "result_cache", job.cache_key,
+                    replay.canonical_hash(table), table=table,
+                )
             stored = self.result_cache.put(
                 job.cache_key, payload, {"query_class": job.query_class}
             )

@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# CI gate for the static-analysis suite (docs/analysis.md).
+#
+# Runs the combined gate (`python -m ballista_tpu.analysis --json`) and
+# fails the build when:
+#   - any analyzer reports non-green (or crashes / is skipped),
+#   - any suppression ledger count grows past its pinned budget
+#     (ballista_tpu/analysis/budget.py),
+#   - wall time exceeds ANALYSIS_GATE_MAX_S (default 12s — 2x the ~6s
+#     parallel baseline; a silent 10x regression here would push the
+#     gate out of the inner loop, which is how lint rot starts).
+#
+# Usage: ci/analysis-gate.sh  (from the repo root; no arguments)
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+MAX_S="${ANALYSIS_GATE_MAX_S:-12}"
+OUT="$(mktemp)"
+trap 'rm -f "$OUT"' EXIT
+
+START=$(date +%s)
+STATUS=0
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m ballista_tpu.analysis --json >"$OUT" || STATUS=$?
+ELAPSED=$(( $(date +%s) - START ))
+
+python - "$OUT" "$STATUS" <<'PY'
+import json
+import sys
+
+path, status = sys.argv[1], int(sys.argv[2])
+doc = json.load(open(path))
+for a in doc["analyzers"]:
+    if a.get("skipped"):
+        print(f"{a['name']}: SKIPPED — the gate runs everything")
+        sys.exit(1)
+    mark = "OK" if a["ok"] else "FAIL"
+    print(f"{a['name']}: {mark} ({a['seconds']}s) — {a['summary']}")
+if not doc["ok"] or status != 0:
+    print(f"FAILED: {', '.join(doc['failed']) or f'exit {status}'}")
+    sys.exit(1)
+
+# budget growth: every ledger count must stay within its pinned budget,
+# and every budgeted analyzer must appear in the ledger
+from ballista_tpu.analysis import budget
+
+sup = doc["suppressions"]
+if "error" in sup:
+    print(f"suppression ledger broken: {sup['error']}")
+    sys.exit(1)
+if set(sup) != set(budget.BUDGETS):
+    print(f"ledger/budget key mismatch: {sorted(sup)} vs "
+          f"{sorted(budget.BUDGETS)}")
+    sys.exit(1)
+over = {
+    k: v["used"] for k, v in sup.items() if v["used"] > v["budget"]
+}
+if over:
+    print(f"suppression budget exceeded: {over} "
+          f"(ledger {sup})")
+    sys.exit(1)
+print("suppressions within budget: " +
+      ", ".join(f"{k}={v['used']}/{v['budget']}"
+                for k, v in sorted(sup.items())))
+PY
+
+if [ "$ELAPSED" -gt "$MAX_S" ]; then
+    echo "analysis gate took ${ELAPSED}s > ${MAX_S}s budget" \
+         "(ANALYSIS_GATE_MAX_S) — investigate before raising the bound"
+    exit 1
+fi
+echo "analysis gate green in ${ELAPSED}s (budget ${MAX_S}s)"

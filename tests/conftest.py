@@ -33,6 +33,8 @@ for _k in (
     "BALLISTA_LOCK_WITNESS",
     "BALLISTA_RESOURCE_WITNESS",
     "BALLISTA_REPLAY_WITNESS",
+    "BALLISTA_CACHE_WITNESS",
+    "BALLISTA_CACHE_WITNESS_SAMPLE",
 ):
     os.environ.pop(_k, None)
 

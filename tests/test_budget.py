@@ -13,7 +13,8 @@ from ballista_tpu.analysis import budget
 def test_every_analyzer_within_budget():
     ledger = budget.ledger()
     assert set(ledger) == {
-        "jaxlint", "racelint", "lifelint", "eqlint", "detlint"
+        "jaxlint", "racelint", "lifelint", "eqlint", "detlint",
+        "stalelint",
     }
     for name, row in ledger.items():
         assert row["used"] <= row["budget"], (
@@ -32,6 +33,7 @@ def test_current_counts_pinned():
         "lifelint": 0,
         "eqlint": 0,
         "detlint": 0,
+        "stalelint": 0,
     }, used
 
 

@@ -1,0 +1,195 @@
+"""Cache-coherence satellites: the bounded plan-cache eviction
+(exec/base.py::evict_plan_cache) and the result-cache version-source
+matrix — every table-mutation path must flip ``result_cache_key`` so a
+stale payload can never be served by key (docs/serving.md).
+"""
+
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.errors import PlanError
+from ballista_tpu.exec.base import (
+    PLAN_CACHE_MAX_ENTRIES,
+    evict_plan_cache,
+    run_with_capacity_retry,
+)
+from ballista_tpu.exec.context import TpuContext
+from ballista_tpu.plan.optimizer import optimize
+from ballista_tpu.scheduler.result_cache import (
+    ResultCache,
+    result_cache_key,
+)
+
+# ---------------------------------------------------------------------------
+# evict_plan_cache
+# ---------------------------------------------------------------------------
+
+
+def _filled(n, start=0):
+    return {("site", i): i for i in range(start, start + n)}
+
+
+def test_under_bound_is_untouched():
+    cache = _filled(100)
+    assert evict_plan_cache(cache) == 0
+    assert len(cache) == 100
+
+
+def test_over_bound_evicts_oldest_first_to_half():
+    cache = _filled(PLAN_CACHE_MAX_ENTRIES + 10)
+    evicted = evict_plan_cache(cache)
+    assert evicted == PLAN_CACHE_MAX_ENTRIES + 10 - (
+        PLAN_CACHE_MAX_ENTRIES // 2
+    )
+    assert len(cache) == PLAN_CACHE_MAX_ENTRIES // 2
+    # survivors are the NEWEST entries (insertion order eviction)
+    assert ("site", 0) not in cache
+    assert ("site", PLAN_CACHE_MAX_ENTRIES + 9) in cache
+
+
+def test_pinned_and_sticky_keys_survive():
+    cache = {"__build_cache_bytes__": 123}
+    cache.update(_filled(PLAN_CACHE_MAX_ENTRIES + 10))
+    pinned = frozenset({("site", 1), ("site", 5)})
+    evict_plan_cache(cache, pinned=pinned)
+    # the oldest entries are gone EXCEPT the pinned snapshot keys and
+    # the shared HBM tally
+    assert cache["__build_cache_bytes__"] == 123
+    assert ("site", 1) in cache and ("site", 5) in cache
+    assert ("site", 0) not in cache
+
+
+def test_eviction_is_metered():
+    from ballista_tpu.compilecache import metrics
+
+    before = metrics.snapshot()
+    cache = _filled(PLAN_CACHE_MAX_ENTRIES + 1)
+    evicted = evict_plan_cache(cache)
+    after = metrics.snapshot()
+    assert after.get("plan_cache_flush", 0) == (
+        before.get("plan_cache_flush", 0) + 1
+    )
+    assert after.get("plan_cache_evicted", 0) == (
+        before.get("plan_cache_evicted", 0) + evicted
+    )
+
+
+def test_run_with_capacity_retry_bounds_without_dropping_pins():
+    """The old behavior at this seam was a wholesale ``clear()`` — the
+    driver must now keep the running task's snapshot-pinned working set
+    while still bounding the cache."""
+    cache = _filled(PLAN_CACHE_MAX_ENTRIES + 50)
+    pinned = frozenset({("site", 2), ("site", 7)})
+    out = run_with_capacity_retry(
+        BallistaConfig(),
+        lambda ctx: len(ctx.plan_cache),
+        plan_cache=cache,
+        pinned_cache_keys=pinned,
+    )
+    assert out == len(cache) <= PLAN_CACHE_MAX_ENTRIES
+    assert pinned <= set(cache)
+
+
+def test_custom_max_entries():
+    cache = _filled(20)
+    evict_plan_cache(cache, max_entries=10)
+    assert len(cache) == 5
+
+
+# ---------------------------------------------------------------------------
+# result-cache version-source matrix
+# ---------------------------------------------------------------------------
+
+
+def _ctx():
+    ctx = TpuContext()
+    ctx.register_table(
+        "t", pa.table({"a": [1, 2, 3], "b": [1.0, 2.0, 3.0]})
+    )
+    return ctx
+
+
+def _key(ctx, cfg=None, sql="select sum(a) as s from t"):
+    cfg = cfg or BallistaConfig()
+    return result_cache_key(optimize(ctx.sql_to_logical(sql)), cfg, ctx)
+
+
+@pytest.mark.parametrize(
+    "mutate",
+    [
+        pytest.param(
+            lambda ctx: ctx.register_table(
+                "t", pa.table({"a": [9], "b": [9.0]})
+            ),
+            id="register-replace",
+        ),
+        pytest.param(
+            lambda ctx: ctx.append_table(
+                "t", pa.table({"a": [4], "b": [4.0]})
+            ),
+            id="append",
+        ),
+        pytest.param(
+            lambda ctx: (
+                ctx.deregister_table("t"),
+                ctx.register_table(
+                    "t", pa.table({"a": [1, 2, 3], "b": [1.0, 2.0, 3.0]})
+                ),
+            ),
+            id="drop-reregister",
+        ),
+    ],
+)
+def test_every_table_mutation_flips_the_key(mutate):
+    ctx = _ctx()
+    cache = ResultCache(capacity_bytes=1 << 20)
+    old_key = _key(ctx)
+    assert old_key is not None
+    cache.put(old_key, b"stale-payload")
+    mutate(ctx)
+    new_key = _key(ctx)
+    assert new_key is not None and new_key != old_key
+    # the stale payload is dead BY KEY: the post-mutation lookup can
+    # never see it
+    assert cache.get(new_key) is None
+
+
+def test_session_setting_change_flips_the_key():
+    ctx = _ctx()
+    cache = ResultCache(capacity_bytes=1 << 20)
+    cfg = BallistaConfig()
+    old_key = _key(ctx, cfg)
+    cache.put(old_key, b"stale-payload")
+    new_key = _key(
+        ctx, cfg.with_setting("ballista.shuffle.partitions", "7")
+    )
+    assert new_key != old_key
+    assert cache.get(new_key) is None
+
+
+def test_no_mutation_preserves_the_key():
+    # the property is IFF-shaped: the key must be stable when nothing
+    # changed, else the cache never hits at all
+    ctx = _ctx()
+    assert _key(ctx) == _key(ctx)
+
+
+# ---------------------------------------------------------------------------
+# append_table semantics (the new mutation primitive the matrix covers)
+# ---------------------------------------------------------------------------
+
+
+def test_append_table_appends_and_queries_see_new_rows():
+    ctx = _ctx()
+    ctx.append_table("t", pa.table({"a": [10], "b": [10.0]}))
+    out = ctx.sql("select sum(a) as s from t").collect()
+    assert out.column("s")[0].as_py() == 16
+
+
+def test_append_table_rejects_unknown_and_schema_mismatch():
+    ctx = _ctx()
+    with pytest.raises(PlanError):
+        ctx.append_table("nope", pa.table({"a": [1]}))
+    with pytest.raises(PlanError):
+        ctx.append_table("t", pa.table({"z": ["wrong"]}))

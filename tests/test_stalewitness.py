@@ -1,0 +1,263 @@
+"""Runtime staleness witness (analysis/stalewitness.py,
+docs/analysis.md#runtime-staleness-witness).
+
+Unit coverage of the witness mechanics (deterministic sampling, the
+expect/resolve demotion protocol, stale recording, drain accounting),
+the prometheus family (parser-level), and in-process acceptance on both
+instrumented caches: a sampled physical-plan-cache hit re-plans and
+hash-matches, and a sampled result-cache hit is demoted to a fresh run
+whose committed repopulation hash-matches what the hit would have
+served.
+"""
+
+import time
+
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.analysis import stalewitness
+
+
+@pytest.fixture(autouse=True)
+def _witness_hygiene():
+    stalewitness.reset()
+    yield
+    stalewitness.enable(False)
+    stalewitness.set_sample_rate(1.0)
+    stalewitness.reset()
+
+
+# ---------------------------------------------------------------------------
+# unit: sampling
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_by_default_and_never_samples():
+    assert not stalewitness.enabled()
+    assert not stalewitness.should_sample("c")
+    assert stalewitness.hit_counts() == {}
+
+
+def test_sampling_is_deterministic_per_cache():
+    stalewitness.enable()
+    assert all(stalewitness.should_sample("a") for _ in range(5))
+    stalewitness.set_sample_rate(0.25)
+    picks = [stalewitness.should_sample("b") for _ in range(8)]
+    assert sum(picks) == 2  # every 4th hit, exactly
+    # rerunning the same stride from a fresh counter reproduces it
+    stalewitness.reset()
+    assert picks == [stalewitness.should_sample("b") for _ in range(8)]
+    stalewitness.set_sample_rate(0.0)
+    assert not any(stalewitness.should_sample("c") for _ in range(10))
+
+
+def test_hit_counts_accumulate_even_when_not_sampled():
+    stalewitness.enable()
+    stalewitness.set_sample_rate(0.5)
+    for _ in range(4):
+        stalewitness.should_sample("x")
+    assert stalewitness.hit_counts() == {"x": 4}
+
+
+# ---------------------------------------------------------------------------
+# unit: expect/resolve/check protocol
+# ---------------------------------------------------------------------------
+
+
+def test_expect_resolve_match_path():
+    stalewitness.expect("result_cache", ("k",), "h1", version=3)
+    assert stalewitness.pending_count() == 1
+    stalewitness.resolve("result_cache", ("k",), "h1", version=3)
+    assert stalewitness.pending_count() == 0
+    assert stalewitness.counters() == {("result_cache", "match"): 1}
+    stalewitness.assert_no_stale()
+
+
+def test_mismatch_records_stale_and_fails_assert():
+    stalewitness.expect("result_cache", ("k",), "served")
+    stalewitness.resolve("result_cache", ("k",), "fresh")
+    assert stalewitness.counters() == {("result_cache", "stale"): 1}
+    (rec,) = stalewitness.stale_hits()
+    assert rec["expected"] == "served" and rec["got"] == "fresh"
+    with pytest.raises(AssertionError, match="stale cache hits"):
+        stalewitness.assert_no_stale()
+
+
+def test_resolve_without_pending_is_silent():
+    # ordinary repopulation (nothing was served from cache): no check
+    stalewitness.resolve("result_cache", ("other",), "h")
+    assert stalewitness.counters() == {}
+
+
+def test_direct_check_compares_in_hand():
+    stalewitness.check("physical_plan_cache", "fp", "a", "a")
+    stalewitness.check("physical_plan_cache", "fp", "a", "b")
+    assert stalewitness.counters() == {
+        ("physical_plan_cache", "match"): 1,
+        ("physical_plan_cache", "stale"): 1,
+    }
+
+
+def test_tables_equivalent_tolerates_ulp_drift_only():
+    t1 = pa.table({"k": [1, 2], "v": [1.0, 2.0]})
+    # row order + last-ULP float shift: equivalent (the certified
+    # multiset-exact drift envelope)
+    t2 = pa.table({"k": [2, 1], "v": [2.0 * (1 + 1e-15), 1.0]})
+    assert stalewitness.tables_equivalent(t1, t2)
+    # a genuinely different float value: not equivalent
+    t3 = pa.table({"k": [1, 2], "v": [1.0, 2.1]})
+    assert not stalewitness.tables_equivalent(t1, t3)
+    # non-float columns stay bit-exact: no tolerance
+    t4 = pa.table({"k": [1, 3], "v": [1.0, 2.0]})
+    assert not stalewitness.tables_equivalent(t1, t4)
+    # shape drift
+    assert not stalewitness.tables_equivalent(
+        t1, pa.table({"k": [1], "v": [1.0]})
+    )
+
+
+def test_resolve_fallback_accepts_certified_float_drift():
+    from ballista_tpu.scheduler.result_cache import table_to_ipc
+
+    served = pa.table({"k": [1, 2], "v": [1.0, 2.0]})
+    fresh = pa.table({"k": [1, 2], "v": [1.0, 2.0 * (1 + 1e-15)]})
+    stalewitness.expect(
+        "result_cache", ("k",), "h-served",
+        payload=table_to_ipc(served),
+    )
+    stalewitness.resolve("result_cache", ("k",), "h-fresh", table=fresh)
+    assert stalewitness.counters() == {("result_cache", "match"): 1}
+    stalewitness.assert_no_stale()
+
+
+def test_resolve_fallback_still_catches_real_staleness():
+    from ballista_tpu.scheduler.result_cache import table_to_ipc
+
+    served = pa.table({"k": [1, 2], "v": [1.0, 2.0]})
+    fresh = pa.table({"k": [1, 2], "v": [1.0, 99.0]})
+    stalewitness.expect(
+        "result_cache", ("k",), "h-served",
+        payload=table_to_ipc(served),
+    )
+    stalewitness.resolve("result_cache", ("k",), "h-fresh", table=fresh)
+    assert stalewitness.counters() == {("result_cache", "stale"): 1}
+
+
+def test_zero_checks_must_not_pass_silently():
+    with pytest.raises(AssertionError, match="checked nothing"):
+        stalewitness.assert_no_stale()
+    stalewitness.assert_no_stale(require_checks=False)
+
+
+def test_summary_names_outcomes():
+    stalewitness.check("c", "k", "a", "a")
+    s = stalewitness.summary()
+    assert "1 checks" in s and "c:match=1" in s and "0 stale" in s
+
+
+# ---------------------------------------------------------------------------
+# prometheus family (parser-level)
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_family_gated_and_rendered():
+    from ballista_tpu.obs.prometheus import (
+        _cache_witness_families,
+        render,
+    )
+
+    assert _cache_witness_families() == []  # witness off -> absent
+    stalewitness.enable()
+    stalewitness.check("result_cache", "k", "a", "a")
+    stalewitness.check("result_cache", "k", "a", "b")
+    text = render(_cache_witness_families())
+    assert (
+        "# TYPE ballista_cache_witness_checks_total counter" in text
+    )
+    assert (
+        'ballista_cache_witness_checks_total'
+        '{cache="result_cache",outcome="match"} 1' in text
+    )
+    assert (
+        'ballista_cache_witness_checks_total'
+        '{cache="result_cache",outcome="stale"} 1' in text
+    )
+
+
+# ---------------------------------------------------------------------------
+# acceptance: physical-plan cache (local context, in-process)
+# ---------------------------------------------------------------------------
+
+
+def test_physical_plan_cache_hit_witnessed_clean():
+    from ballista_tpu.exec.context import TpuContext
+
+    stalewitness.enable()
+    ctx = TpuContext()
+    ctx.register_table(
+        "t", pa.table({"g": [1, 2, 1, 2], "v": [1.0, 2.0, 3.0, 4.0]})
+    )
+    sql = "select g, sum(v) as s from t group by g order by g"
+    r1 = ctx.sql(sql).collect()
+    r2 = ctx.sql(sql).collect()  # physical-plan cache hit, sampled
+    assert r2.equals(r1)
+    counts = stalewitness.counters()
+    assert counts.get(("physical_plan_cache", "match"), 0) >= 1, counts
+    stalewitness.assert_no_stale()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: result cache demotion (standalone cluster, in-process)
+# ---------------------------------------------------------------------------
+
+
+def _drain_pending(timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if stalewitness.pending_count() == 0:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"{stalewitness.pending_count()} demoted hits never resolved"
+    )
+
+
+def test_result_cache_demoted_hit_hash_matches():
+    from ballista_tpu.client.context import BallistaContext
+    from ballista_tpu.config import BallistaConfig
+
+    stalewitness.enable()
+    cfg = (
+        BallistaConfig()
+        .with_setting("ballista.shuffle.partitions", "2")
+        .with_setting("ballista.tpu.result_cache_mb", "16")
+    )
+    ctx = BallistaContext.standalone(cfg)
+    sched = ctx._standalone_cluster.scheduler
+    try:
+        ctx.register_table(
+            "t",
+            pa.table(
+                {"k": [i % 5 for i in range(200)],
+                 "v": [float(i) for i in range(200)]}
+            ),
+        )
+        sql = "select k, sum(v) as s from t group by k order by k"
+        cold = ctx.sql(sql).collect()
+        deadline = time.time() + 10.0
+        while (
+            sched.result_cache.stats()["entries"] < 1
+            and time.time() < deadline
+        ):
+            time.sleep(0.02)
+        assert sched.result_cache.stats()["entries"] >= 1
+        # sampled hit: demoted to a fresh run, which must still return
+        # the correct rows AND repopulate with a matching content hash
+        hot = ctx.sql(sql).collect()
+        assert hot.equals(cold)
+        _drain_pending()
+        counts = stalewitness.counters()
+        assert counts.get(("result_cache", "match"), 0) >= 1, counts
+        stalewitness.assert_no_stale()
+    finally:
+        ctx.close()

@@ -1,0 +1,222 @@
+"""Staleness witness under chaos (docs/analysis.md
+#runtime-staleness-witness): a two-executor cluster with the result
+cache AND the cache witness on (sample rate 1: every hit demotes to a
+fresh run) runs TPC-H q3 through the three events that historically
+produce stale serves — an executor kill mid-query (lineage recovery),
+a table append between queries (version-source flip), and adaptive
+re-planning (AQE on throughout) — and must finish with ZERO stale
+hits, every demoted hit resolved by a hash-matching repopulation, the
+resource witness drained, and the replay witness clean.
+
+Marked ``chaos``: witness envs are enabled in the SUBPROCESS only.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from tests.conftest import CPU_MESH_ENV
+
+SCRIPT = r"""
+import pathlib
+import threading
+import time
+
+import pyarrow as pa
+
+from ballista_tpu.analysis import replay, reswitness, stalewitness
+from ballista_tpu.client.context import BallistaContext
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.tpch import gen_all
+
+assert stalewitness.enabled(), "BALLISTA_CACHE_WITNESS must reach here"
+assert stalewitness.sample_rate() == 1.0
+assert reswitness.enabled(), "BALLISTA_RESOURCE_WITNESS must reach here"
+replay.enable()
+
+data = gen_all(scale=0.01)
+q3 = pathlib.Path("benchmarks/queries/q3.sql").read_text()
+qsum = "select sum(l_quantity) as q from lineitem"
+
+cfg = (
+    BallistaConfig()
+    .with_setting("ballista.shuffle.partitions", "2")
+    .with_setting("ballista.tpu.result_cache_mb", "16")
+    .with_setting("ballista.tpu.fetch_backoff_ms", "10")
+    # real shuffle stages (a kill needs shuffle output to lose) and
+    # adaptive re-planning on, so accepted rewrites ride every pass
+    .with_setting("ballista.tpu.collective_shuffle", "false")
+    .with_setting("ballista.tpu.aqe", "true")
+)
+ctx = BallistaContext.standalone(
+    cfg, n_executors=2, executor_timeout_s=2.0,
+    expiry_check_interval_s=0.5,
+)
+for name, t in data.items():
+    ctx.register_table(name, t)
+cluster = ctx._standalone_cluster
+sched = cluster.scheduler
+
+
+def drain_pending(timeout=60):
+    deadline = time.time() + timeout
+    while stalewitness.pending_count() and time.time() < deadline:
+        time.sleep(0.05)
+    assert stalewitness.pending_count() == 0, (
+        "demoted hits never resolved"
+    )
+
+
+def wait_entries(n, timeout=30):
+    deadline = time.time() + timeout
+    while (
+        sched.result_cache.stats()["entries"] < n
+        and time.time() < deadline
+    ):
+        time.sleep(0.05)
+    assert sched.result_cache.stats()["entries"] >= n, (
+        sched.result_cache.stats()
+    )
+
+
+# ---- phase 1: warm, then a demoted hit must hash-match ---------------------
+cold = ctx.sql(q3).collect()
+assert cold.num_rows > 0
+wait_entries(1)
+hot = ctx.sql(q3).collect()  # sampled hit -> demoted -> fresh run
+assert hot.num_rows == cold.num_rows
+drain_pending()
+assert stalewitness.counters().get(("result_cache", "match"), 0) >= 1, (
+    stalewitness.counters()
+)
+print("WARM-OK", stalewitness.summary())
+
+# ---- phase 2: executor kill mid-query --------------------------------------
+# every hit demotes (rate 1), so re-submitting q3 always runs the full
+# stage machinery — the kill has real shuffle output to destroy, and the
+# post-recovery repopulation must STILL hash-match what the demoted hit
+# would have served
+
+
+def attempt_kill_mid_query():
+    result = {}
+
+    def drive():
+        result["q3"] = ctx.sql(q3).collect()
+
+    t3 = threading.Thread(target=drive)
+    t3.start()
+    victim_id = None
+    deadline = time.time() + 120
+    while time.time() < deadline and victim_id is None:
+        for (job_id, stage_id), stage in list(
+            sched.stage_manager._stages.items()
+        ):
+            for task in stage.tasks:
+                if task.state.value == "completed" and task.executor_id:
+                    victim_id = task.executor_id
+                    break
+            if victim_id:
+                break
+        time.sleep(0.005)
+    job = list(sched.jobs.values())[-1]
+    if victim_id is None or job.status != "running":
+        t3.join(timeout=300)
+        return None  # query outran the kill window — retry
+    victim_idx = next(
+        i for i, h in enumerate(cluster.executors)
+        if h.executor.executor_id == victim_id
+    )
+    cluster.kill_executor(victim_idx, lose_shuffle=True)
+    cluster.add_executor()
+    t3.join(timeout=300)
+    assert not t3.is_alive(), "q3 wedged after executor kill"
+    assert job.status == "completed", (job.status, job.error)
+    return job, result["q3"]
+
+
+got = None
+for _round in range(3):
+    got = attempt_kill_mid_query()
+    if got is not None:
+        break
+assert got is not None, "kill never landed mid-query in 3 rounds"
+job, chaos_result = got
+assert chaos_result.num_rows == cold.num_rows
+assert job.total_retries + job.total_recomputes >= 1, (
+    "kill left no recovery trace"
+)
+drain_pending()
+print("KILL-OK", job.total_retries, job.total_recomputes)
+
+# ---- phase 3: append between queries (version-source flip) -----------------
+before = ctx.sql(qsum).collect().column("q")[0].as_py()
+wait_entries(1)
+extra = data["lineitem"].slice(0, 50)
+ctx.append_table("lineitem", extra)
+after = ctx.sql(qsum).collect().column("q")[0].as_py()
+expect = before + sum(
+    extra.column("l_quantity").to_pylist()
+)
+assert abs(after - expect) < 1e-6, (before, after, expect)
+# the appended rows flipped every lineitem-scanning key: the old q3
+# entry is dead BY KEY, and the re-run + its own demoted re-check must
+# still be coherent against the NEW data
+new_q3 = ctx.sql(q3).collect()
+wait_entries(1)
+again = ctx.sql(q3).collect()  # demoted hit on the post-append key
+assert again.num_rows == new_q3.num_rows
+drain_pending()
+print("APPEND-OK", before, "->", after)
+
+# ---- verdict ---------------------------------------------------------------
+counts = stalewitness.counters()
+assert counts.get(("result_cache", "match"), 0) >= 3, counts
+assert counts.get(("result_cache", "stale"), 0) == 0, (
+    stalewitness.stale_hits()
+)
+stalewitness.assert_no_stale()
+print("WITNESS-OK", stalewitness.summary())
+
+ctx.close()
+from ballista_tpu.client.flight import close_pool
+close_pool()
+
+deadline = time.time() + 30
+while reswitness.live() and time.time() < deadline:
+    time.sleep(0.1)
+reswitness.assert_drained()
+replay.assert_clean()
+print("STALE-CHAOS-OK")
+"""
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # ~60s wall (cluster boot + mid-query kill retry
+# rounds + demoted re-runs) — over the tier-1 budget, runs in slow tier
+def test_zero_stale_hits_under_kill_append_and_aqe():
+    proc = subprocess.run(
+        [sys.executable, "-c", SCRIPT],
+        env={
+            **CPU_MESH_ENV,
+            "BALLISTA_CACHE_WITNESS": "1",
+            "BALLISTA_RESOURCE_WITNESS": "1",
+        },
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    for marker in (
+        "WARM-OK", "KILL-OK", "APPEND-OK", "WITNESS-OK",
+        "STALE-CHAOS-OK",
+    ):
+        assert marker in proc.stdout, (
+            f"missing {marker}\nstdout:\n{proc.stdout}\n"
+            f"stderr:\n{proc.stderr[-4000:]}"
+        )
