@@ -1,6 +1,6 @@
 """Combined static-analysis gate: ``python -m ballista_tpu.analysis``.
 
-Runs all eleven analyzers with one exit code and a per-analyzer summary
+Runs all twelve analyzers with one exit code and a per-analyzer summary
 line — the single command CI (and a developer pre-push) needs:
 
 - **planlint** — the plan verifier over the TPC-H q1-q22 corpus
@@ -45,6 +45,13 @@ line — the single command CI (and a developer pre-push) needs:
   validation seam; its runtime counterpart is the staleness witness
   (:mod:`ballista_tpu.analysis.stalewitness`,
   ``BALLISTA_CACHE_WITNESS=1``).
+- **durlint** — distributed-durability lint over the declared state
+  registry (analysis/durreg.py): undeclared mutable control-plane
+  state, mutators that drop a declared persistence call, persisted
+  keys never read back in ``_recover_state``, and state-backend writes
+  outside the lock/ownership seam (the two-scheduler split-brain
+  shape); its runtime counterpart is the durability witness
+  (:mod:`ballista_tpu.analysis.durwitness`, ``BALLISTA_DUR_WITNESS=1``).
 
 Suppression budgets for every AST analyzer live in ONE ledger
 (:mod:`ballista_tpu.analysis.budget`) enforced here and pinned by a
@@ -57,12 +64,15 @@ order is fixed regardless.
 
 Flags: ``--json`` emits one machine-readable document (per-analyzer
 ok/summary/seconds, the suppression ledger, and the failure list) for CI
-annotation instead of the human lines; ``--dot`` prints the racelint
-lock-order graph (Graphviz) and exits; ``--tables`` prints the canonical
-status state machines and exits; ``--write-config-docs`` regenerates
-docs/config.md and exits; ``--skip a,b`` / ``--only a,b`` select
-analyzers; ``--queries 1,3,6`` limits the TPC-H corpus (tier-1 runs a
-subset — the full corpus is covered by tests/test_plan_verifier.py).
+annotation instead of the human lines; ``--list`` prints the registered
+analyzer names one per line (ci/analysis-gate.sh diffs this against its
+pinned matrix, so an analyzer added here but not there — or vice versa —
+fails CI); ``--dot`` prints the racelint lock-order graph (Graphviz) and
+exits; ``--tables`` prints the canonical status state machines and
+exits; ``--write-config-docs`` regenerates docs/config.md and exits;
+``--skip a,b`` / ``--only a,b`` select analyzers; ``--queries 1,3,6``
+limits the TPC-H corpus (tier-1 runs a subset — the full corpus is
+covered by tests/test_plan_verifier.py).
 """
 
 from __future__ import annotations
@@ -75,7 +85,7 @@ import time
 ANALYZERS = (
     "planlint", "serde-audit", "jaxlint", "racelint", "compile-vocab",
     "lifelint", "proto-drift", "config-registry", "eqlint", "detlint",
-    "stalelint",
+    "stalelint", "durlint",
 )
 
 # analyzers sharing one worker under parallel execution: planlint and
@@ -296,6 +306,26 @@ def run_stalelint() -> tuple[bool, str]:
     )
 
 
+def run_durlint() -> tuple[bool, str]:
+    from ballista_tpu.analysis import budget, durlint, durreg
+
+    problems = durreg.verify_anchors()
+    docs = durreg.docs_in_sync()
+    if docs:
+        problems.append(docs)
+    diags = durlint.lint_paths()
+    sup = durlint.suppression_count()
+    if problems or diags:
+        return False, "\n".join(problems + [str(d) for d in diags])
+    over = budget.check("durlint", sup)
+    if over:
+        return False, over
+    return True, (
+        f"0 findings, {sup} suppressions, {len(durreg.STATE)} declared "
+        f"state entries / {len(durreg.CONTRACTS)} persistence contracts"
+    )
+
+
 def _runners(queries):
     """Resolved at call time from module attributes, so tests can
     monkeypatch individual runners."""
@@ -311,6 +341,7 @@ def _runners(queries):
         "eqlint": run_eqlint,
         "detlint": run_detlint,
         "stalelint": run_stalelint,
+        "durlint": run_durlint,
     }
 
 
@@ -414,6 +445,11 @@ def main(argv=None) -> int:
         help="run analyzers one at a time instead of concurrently",
     )
     ap.add_argument(
+        "--list", action="store_true",
+        help="print the registered analyzer names (one per line) and "
+        "exit — CI diffs this against its pinned matrix",
+    )
+    ap.add_argument(
         "--dot", action="store_true",
         help="print the racelint lock-order graph (Graphviz) and exit",
     )
@@ -427,6 +463,10 @@ def main(argv=None) -> int:
         "exit",
     )
     args = ap.parse_args(argv)
+    if args.list:
+        for name in ANALYZERS:
+            print(name)
+        return 0
     if args.write_config_docs:
         from ballista_tpu.analysis import configlint
 

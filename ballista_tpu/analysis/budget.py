@@ -25,6 +25,7 @@ BUDGETS: dict[str, int] = {
     "eqlint": 5,
     "detlint": 5,
     "stalelint": 5,
+    "durlint": 5,
 }
 
 
@@ -49,6 +50,7 @@ def ledger() -> dict[str, dict[str, int]]:
     payload the single budget test and ``--json`` report from."""
     from ballista_tpu.analysis import (
         detlint,
+        durlint,
         eqlint,
         jaxlint,
         lifelint,
@@ -63,6 +65,7 @@ def ledger() -> dict[str, dict[str, int]]:
         "eqlint": eqlint.suppression_count(),
         "detlint": detlint.suppression_count(),
         "stalelint": stalelint.suppression_count(),
+        "durlint": durlint.suppression_count(),
     }
     assert set(counts) == set(BUDGETS), (
         "budget ledger and analyzer set drifted apart"

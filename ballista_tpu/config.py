@@ -252,6 +252,21 @@ ENV_REGISTRY: tuple[EnvEntry, ...] = (
         "docs/analysis.md",
     ),
     EnvEntry(
+        "BALLISTA_DUR_WITNESS", "0|1", "0",
+        "Runtime durability witness: a restarted scheduler's recovered "
+        "state is diffed against the declared durability classes — "
+        "persisted fields round-trip, rebuilt fields converge, "
+        "ephemeral fields start empty (analysis/durwitness.py)",
+        "docs/analysis.md",
+    ),
+    EnvEntry(
+        "BALLISTA_RPC_TIMEOUT_S", "seconds", "30",
+        "Default per-call deadline for scheduler-side gRPC/etcd client "
+        "calls (scheduler/rpc.py stubs, etcd lease/lock); 0 disables "
+        "the default deadline",
+        "docs/deployment.md",
+    ),
+    EnvEntry(
         "BALLISTA_AQE", "0|1", "",
         "Process-wide adaptive-query-execution override: 0/off forces "
         "the AQE policy off regardless of session config (the ops "

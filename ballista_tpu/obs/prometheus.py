@@ -303,6 +303,7 @@ def scheduler_families(server) -> list[tuple]:
     families.extend(server.hists.families())
     families.extend(_reswitness_families())
     families.extend(_cache_witness_families())
+    families.extend(_dur_witness_families())
     return families
 
 
@@ -376,6 +377,27 @@ def _cache_witness_families() -> list[tuple]:
         ("ballista_cache_witness_checks_total", "counter",
          "Cache staleness witness checks by cache and outcome "
          "(analysis/stalewitness.py)",
+         samples or [({}, 0)])
+    ]
+
+
+def _dur_witness_families() -> list[tuple]:
+    """Durability-witness check outcomes when the durability witness is
+    on (BALLISTA_DUR_WITNESS=1) — empty otherwise. A scrape seeing any
+    ``outcome="divergent"`` sample has caught recovered state diverging
+    from its declared durability class live."""
+    from ballista_tpu.analysis import durwitness
+
+    if not durwitness.enabled():
+        return []
+    samples = [
+        ({"field": field, "outcome": outcome}, n)
+        for (field, outcome), n in sorted(durwitness.counters().items())
+    ]
+    return [
+        ("ballista_dur_witness_checks_total", "counter",
+         "Durability witness restart checks by declared state field and "
+         "outcome (analysis/durwitness.py)",
          samples or [({}, 0)])
     ]
 

@@ -27,6 +27,7 @@ import grpc
 log = logging.getLogger(__name__)
 
 from ballista_tpu.proto import etcd_pb2 as epb
+from ballista_tpu.scheduler.rpc import _with_deadline, rpc_timeout_s
 from ballista_tpu.scheduler.state_backend import (
     StateBackendClient,
     Watch,
@@ -67,11 +68,16 @@ class _EtcdStub:
 
     def __init__(self, channel: grpc.Channel) -> None:
         def u(path, resp):
-            return channel.unary_unary(
+            # Every unary etcd call carries the default per-call deadline
+            # (BALLISTA_RPC_TIMEOUT_S): an unreachable etcd member must
+            # fail the call, not wedge the scheduler under its state
+            # lock. The watch / lease_keep_alive STREAMS below stay
+            # unbounded — their lifetime is the subscription's.
+            return _with_deadline(channel.unary_unary(
                 path,
                 request_serializer=lambda r: r.SerializeToString(),
                 response_deserializer=resp.FromString,
-            )
+            ))
 
         self.range = u("/etcdserverpb.KV/Range", epb.RangeResponse)
         self.put = u("/etcdserverpb.KV/Put", epb.PutResponse)
@@ -143,8 +149,17 @@ class _EtcdLock:
         self._lease = self._stub.lease_grant(
             epb.LeaseGrantRequest(TTL=LOCK_LEASE_TTL_S)
         ).ID
+        # Lock acquisition may legitimately wait out a CRASHED holder's
+        # lease (TTL expiry frees it), so its deadline is wider than the
+        # default unary deadline; timeout=None (deadline disabled) keeps
+        # the historical unbounded wait.
+        default = rpc_timeout_s()
+        lock_timeout = (
+            max(default, 2.0 * LOCK_LEASE_TTL_S) if default > 0 else None
+        )
         self._key = self._stub.lock(
-            epb.LockRequest(name=GLOBAL_LOCK_NAME, lease=self._lease)
+            epb.LockRequest(name=GLOBAL_LOCK_NAME, lease=self._lease),
+            timeout=lock_timeout,
         ).key
         self._start_keepalive()
         return self

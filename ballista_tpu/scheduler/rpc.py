@@ -8,12 +8,27 @@ proto/ballista_tpu.proto services — ref ballista.proto:917-940).
 
 from __future__ import annotations
 
+import os
+
 import grpc
 
 from ballista_tpu.proto import pb
 
 SCHEDULER_SERVICE = "ballista_tpu.SchedulerGrpc"
 EXECUTOR_SERVICE = "ballista_tpu.ExecutorGrpc"
+
+
+def rpc_timeout_s() -> float:
+    """Default per-call deadline for client stubs built here (and the
+    etcd unary calls — scheduler/etcd_backend.py). Unbounded RPCs are
+    how a hung peer wedges the control plane: every unary call gets
+    this deadline unless the caller passes an explicit ``timeout=``.
+    0 (or negative) disables the default, restoring unbounded calls."""
+    raw = os.environ.get("BALLISTA_RPC_TIMEOUT_S", "") or "30"
+    try:
+        return float(raw)
+    except ValueError:
+        return 30.0
 
 SCHEDULER_METHODS = {
     "PollWork": (pb.PollWorkParams, pb.PollWorkResult),
@@ -51,17 +66,32 @@ def add_service(server: grpc.Server, service: str, methods: dict, impl) -> None:
     )
 
 
+def _with_deadline(call):
+    """Apply the default deadline to a unary callable unless the caller
+    chose one (timeout=None explicitly requests an unbounded call)."""
+
+    def invoke(request, *args, **kwargs):
+        if args or "timeout" in kwargs:
+            return call(request, *args, **kwargs)
+        default = rpc_timeout_s()
+        if default > 0:
+            kwargs["timeout"] = default
+        return call(request, **kwargs)
+
+    return invoke
+
+
 class _Stub:
     def __init__(self, channel: grpc.Channel, service: str, methods: dict):
         for name, (req, resp) in methods.items():
             setattr(
                 self,
                 name,
-                channel.unary_unary(
+                _with_deadline(channel.unary_unary(
                     f"/{service}/{name}",
                     request_serializer=lambda r: r.SerializeToString(),
                     response_deserializer=resp.FromString,
-                ),
+                )),
             )
 
 

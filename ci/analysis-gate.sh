@@ -3,21 +3,49 @@
 #
 # Runs the combined gate (`python -m ballista_tpu.analysis --json`) and
 # fails the build when:
+#   - the registered analyzer list (`--list`) drifts from the matrix
+#     pinned below (an analyzer wired into __main__.py but not this
+#     gate — or vice versa — would silently run nowhere),
 #   - any analyzer reports non-green (or crashes / is skipped),
 #   - any suppression ledger count grows past its pinned budget
 #     (ballista_tpu/analysis/budget.py),
-#   - wall time exceeds ANALYSIS_GATE_MAX_S (default 12s — 2x the ~6s
-#     parallel baseline; a silent 10x regression here would push the
-#     gate out of the inner loop, which is how lint rot starts).
+#   - wall time exceeds ANALYSIS_GATE_MAX_S (default 15s — ~2x the
+#     parallel baseline with the 12-analyzer matrix; a silent 10x
+#     regression here would push the gate out of the inner loop, which
+#     is how lint rot starts).
 #
 # Usage: ci/analysis-gate.sh  (from the repo root; no arguments)
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
-MAX_S="${ANALYSIS_GATE_MAX_S:-12}"
+MAX_S="${ANALYSIS_GATE_MAX_S:-15}"
 OUT="$(mktemp)"
 trap 'rm -f "$OUT"' EXIT
+
+# The pinned 12-analyzer matrix. Adding an analyzer means editing BOTH
+# __main__.py's ANALYZERS and this list, in plain sight of this diff.
+EXPECTED_ANALYZERS="planlint
+serde-audit
+jaxlint
+racelint
+compile-vocab
+lifelint
+proto-drift
+config-registry
+eqlint
+detlint
+stalelint
+durlint"
+
+LISTED="$(JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m ballista_tpu.analysis --list)"
+if [ "$LISTED" != "$EXPECTED_ANALYZERS" ]; then
+    echo "analyzer matrix drift: \`python -m ballista_tpu.analysis" \
+         "--list\` disagrees with the matrix pinned in ci/analysis-gate.sh"
+    diff <(echo "$EXPECTED_ANALYZERS") <(echo "$LISTED") || true
+    exit 1
+fi
 
 START=$(date +%s)
 STATUS=0

@@ -35,6 +35,7 @@ for _k in (
     "BALLISTA_REPLAY_WITNESS",
     "BALLISTA_CACHE_WITNESS",
     "BALLISTA_CACHE_WITNESS_SAMPLE",
+    "BALLISTA_DUR_WITNESS",
 ):
     os.environ.pop(_k, None)
 

@@ -14,7 +14,7 @@ def test_every_analyzer_within_budget():
     ledger = budget.ledger()
     assert set(ledger) == {
         "jaxlint", "racelint", "lifelint", "eqlint", "detlint",
-        "stalelint",
+        "stalelint", "durlint",
     }
     for name, row in ledger.items():
         assert row["used"] <= row["budget"], (
@@ -34,6 +34,7 @@ def test_current_counts_pinned():
         "eqlint": 0,
         "detlint": 0,
         "stalelint": 0,
+        "durlint": 0,
     }, used
 
 

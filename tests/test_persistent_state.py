@@ -47,14 +47,18 @@ def test_sqlite_survives_reopen(tmp_path):
 def test_scheduler_restart_recovery(tmp_path):
     """Full restart cycle through a real standalone cluster: run a job to
     completion over a sqlite backend, build a fresh SchedulerServer over
-    the same backend, and verify the completed job (status, result
-    locations, stage plans) and session come back."""
+    the same backend, and verify the FULL declared durability inventory
+    (analysis/durreg.py) comes back: the completed job (status, result
+    locations, stage plans), the session, the registered executors'
+    metadata, an in-flight job closed out as exactly one failed terminal
+    history record, and a provably cold result cache."""
     script = rf"""
 import numpy as np
 import pyarrow as pa
 
+from ballista_tpu.analysis import durwitness
 from ballista_tpu.client.context import BallistaContext
-from ballista_tpu.scheduler.server import SchedulerServer
+from ballista_tpu.scheduler.server import JobInfo, SchedulerServer
 from ballista_tpu.scheduler.state_backend import SqliteBackend
 
 path = {str(tmp_path / 'sched.db')!r}
@@ -81,6 +85,17 @@ assert old_job.status == "completed"
 n_locs = len(old_job.completed_locations)
 assert n_locs > 0
 session_id = ctx.session_id
+exec_ids = {{m.id for m in cluster.scheduler.state.load_executors()}}
+assert exec_ids, "live cluster persisted its executor metadata"
+
+# a job the scheduler dies holding: running in memory AND on the
+# backend, with its submit record in the history log
+mid = JobInfo(job_id="inflt001", session_id=session_id, status="running")
+with cluster.scheduler._lock:
+    cluster.scheduler.jobs[mid.job_id] = mid
+cluster.scheduler.state.save_job(mid)
+cluster.scheduler.history.record_submit(mid.job_id, session_id=session_id)
+
 cluster.poll_loop.stop()
 cluster.scheduler.shutdown()
 cluster.scheduler_grpc.stop(grace=None)
@@ -92,6 +107,21 @@ assert job.status == "completed", job.status
 assert len(job.completed_locations) == n_locs
 assert job.completed_locations[0].path
 assert session_id in recovered.sessions
+# executor metadata: the full registered set survives the restart
+assert {{m.id for m in recovered.state.load_executors()}} == exec_ids
+for eid in exec_ids:
+    assert recovered.executor_manager.get_executor_metadata(eid) is not None
+# the in-flight job is closed out loudly, with exactly ONE failed
+# terminal history record — never a dangling "running"
+j = recovered.jobs["inflt001"]
+assert j.status == "failed" and "restart" in j.error
+assert durwitness.terminal_history_counts(
+    recovered.history, "inflt001") == {{"completed": 0, "failed": 1}}
+# and the completed job keeps exactly its one completed record
+assert durwitness.terminal_history_counts(
+    recovered.history, job_id) == {{"completed": 1, "failed": 0}}
+# result cache is provably cold after a restart (declared ephemeral)
+assert recovered.result_cache.stats()["entries"] == 0
 # stage plans decode back into executable fragments
 assert job.stages, "stage plans must be recovered"
 for stage in job.stages.values():
@@ -137,6 +167,87 @@ def test_inflight_job_fails_loudly_on_restart(tmp_path):
     assert j.status == "failed"
     assert "restart" in j.error
     recovered.shutdown()
+
+
+def _terminal_job_edges():
+    """The terminal edges of the declared job state machine — derived
+    from the table itself so adding an edge forces this test to cover
+    it."""
+    from ballista_tpu.analysis.statemachine import JOB_TRANSITIONS
+
+    edges = sorted(
+        (src, dst)
+        for (src, dst) in JOB_TRANSITIONS
+        if dst in ("completed", "failed")
+    )
+    assert edges == [
+        ("queued", "failed"),
+        ("running", "completed"),
+        ("running", "failed"),
+    ], edges
+    return edges
+
+
+@pytest.mark.parametrize("src,dst", _terminal_job_edges())
+def test_terminal_transition_saves_job_exactly_once(src, dst):
+    """Property over JOB_TRANSITIONS: every terminal edge of the job
+    state machine drives exactly ONE ``save_job`` write-through, and the
+    persisted payload is recoverable — a fresh scheduler over the same
+    backend sees the terminal status, and the history log holds exactly
+    one terminal record (the durlint job-terminal persistence
+    contract, analysis/durreg.py)."""
+    from types import SimpleNamespace
+
+    from ballista_tpu.analysis import durwitness
+    from ballista_tpu.scheduler.persistent_state import (
+        PersistentSchedulerState,
+    )
+    from ballista_tpu.scheduler.server import JobInfo, SchedulerServer
+
+    backend = MemoryBackend()
+    server = SchedulerServer(provider=None, state_backend=backend)
+    try:
+        job = JobInfo(job_id="prop0001", session_id="s1", status=src)
+        if dst == "completed":
+            # _on_job_finished reads the final stage's partition count;
+            # no tasks ever ran, so the location list is just empty
+            job.stages = {0: SimpleNamespace(output_partition_count=1)}
+        with server._lock:
+            server.jobs[job.job_id] = job
+
+        saves = []
+        real_save = server.state.save_job
+        server.state.save_job = lambda j: (
+            saves.append((j.job_id, j.status)), real_save(j))[-1]
+        if dst == "completed":
+            server._on_job_finished(job.job_id)
+        else:
+            server._on_job_failed(job.job_id, "attempts exhausted")
+        server.state.save_job = real_save
+
+        assert saves == [("prop0001", dst)], saves
+        (row,) = server.state.load_jobs()
+        assert row["status"] == dst
+        assert PersistentSchedulerState.locations_from_json(
+            row["locations"]) == []
+        counts = durwitness.terminal_history_counts(
+            server.history, job.job_id)
+        assert counts[dst] == 1 and sum(counts.values()) == 1, counts
+    finally:
+        server.shutdown()
+
+    # recoverable payload: a restarted scheduler over the same backend
+    # serves the terminal status without re-recording history
+    recovered = SchedulerServer(provider=None, state_backend=backend)
+    try:
+        assert recovered.jobs["prop0001"].status == dst
+        if dst == "failed":
+            assert recovered.jobs["prop0001"].error == "attempts exhausted"
+        counts = durwitness.terminal_history_counts(
+            recovered.history, "prop0001")
+        assert sum(counts.values()) == 1, counts
+    finally:
+        recovered.shutdown()
 
 
 def test_state_backend_watch():
